@@ -1,0 +1,10 @@
+"""EventStreamGPT-TPU: a TPU-native framework for generative modeling of event streams.
+
+A from-scratch JAX/Flax/Pallas re-design with the full capabilities of the
+EventStreamGPT reference (data pipeline, conditionally-independent and
+nested-attention point-process transformers, autoregressive generation,
+fine-tuning / zero-shot / embedding workflows), built for XLA compilation,
+SPMD sharding over device meshes, and MXU-friendly static shapes.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,43 @@
+from .config import (
+    DatasetConfig,
+    DatasetSchema,
+    InputDFSchema,
+    MeasurementConfig,
+    PytorchDatasetConfig,
+    SeqPaddingSide,
+    SubsequenceSamplingStrategy,
+    VocabularyConfig,
+)
+from .time_dependent_functor import AgeFunctor, TimeDependentFunctor, TimeOfDayFunctor
+from .types import (
+    DataModality,
+    EventStreamBatch,
+    InputDataType,
+    InputDFType,
+    NumericDataModalitySubtype,
+    TemporalityType,
+    de_pad,
+)
+from .vocabulary import Vocabulary
+
+__all__ = [
+    "AgeFunctor",
+    "DataModality",
+    "DatasetConfig",
+    "DatasetSchema",
+    "EventStreamBatch",
+    "InputDataType",
+    "InputDFSchema",
+    "InputDFType",
+    "MeasurementConfig",
+    "NumericDataModalitySubtype",
+    "PytorchDatasetConfig",
+    "SeqPaddingSide",
+    "SubsequenceSamplingStrategy",
+    "TemporalityType",
+    "TimeDependentFunctor",
+    "TimeOfDayFunctor",
+    "Vocabulary",
+    "VocabularyConfig",
+    "de_pad",
+]

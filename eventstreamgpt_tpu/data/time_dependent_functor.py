@@ -1,0 +1,200 @@
+"""Functional time-dependent measurements (age, time-of-day).
+
+TPU-native rebuild of ``/root/reference/EventStream/data/time_dependent_functor.py``.
+Each functor is dual-implemented:
+
+1. ``compute(events_df, static_df)`` — a **pandas** evaluation used during ETL
+   (the reference uses a Polars expression, ``time_dependent_functor.py:62``;
+   Polars is unavailable in this environment, and ETL is host-side anyway).
+2. ``update_from_prior_timepoint`` — a pure **jnp** update used inside the
+   jitted generation loop (the reference uses torch,
+   ``time_dependent_functor.py:149,262``). Static Python scalars (vocab
+   indices, normalizer params) are baked in at trace time; array arguments are
+   traced, so the update is ``lax.scan``-safe.
+"""
+
+from __future__ import annotations
+
+import abc
+from datetime import datetime
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+from .types import DataModality
+from .vocabulary import Vocabulary
+
+MINUTES_PER_YEAR = 60 * 24 * 365.25
+
+
+class TimeDependentFunctor(abc.ABC):
+    """ABC for measurements that are analytic functions of time + static data.
+
+    Reference contract: ``time_dependent_functor.py:23-113``.
+    """
+
+    OUTPUT_MODALITY: DataModality = DataModality.DROPPED
+
+    def __init__(self, **fn_params):
+        for k, val in fn_params.items():
+            setattr(self, k, val)
+        self.link_static_cols: list[str] = []
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "class": self.__class__.__name__,
+            "params": {k: v for k, v in vars(self).items() if k != "link_static_cols"},
+        }
+
+    @classmethod
+    def from_dict(cls, in_dict: dict[str, Any]) -> "TimeDependentFunctor":
+        return cls(**in_dict["params"])
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, TimeDependentFunctor) and self.to_dict() == other.to_dict()
+
+    @abc.abstractmethod
+    def compute(self, timestamps: pd.Series, static_row_df: pd.DataFrame) -> pd.Series:
+        """Evaluates the functor for each event.
+
+        Args:
+            timestamps: Event timestamps (datetime series), aligned with
+                ``static_row_df`` rows (one static row per event).
+            static_row_df: Per-event static data (already joined onto events).
+
+        Returns:
+            A series of measurement values (float or categorical string).
+        """
+        raise NotImplementedError("Must be implemented in subclass!")
+
+    @abc.abstractmethod
+    def update_from_prior_timepoint(
+        self,
+        prior_indices,
+        prior_values,
+        new_delta,
+        new_time,
+        vocab: Vocabulary | None,
+        measurement_metadata: pd.Series | None,
+    ):
+        """jnp update used in the generation loop; see class docstring."""
+        raise NotImplementedError("Must be implemented in subclass!")
+
+
+class AgeFunctor(TimeDependentFunctor):
+    """The subject's age, in fixed-length (365.25-day) years.
+
+    Reference: ``time_dependent_functor.py:116-225``.
+
+    Examples:
+        >>> import pandas as pd
+        >>> from datetime import datetime
+        >>> f = AgeFunctor(dob_col="birth_date")
+        >>> ts = pd.Series([datetime(2020, 1, 1), datetime(2021, 1, 1)])
+        >>> st = pd.DataFrame({"birth_date": [datetime(1990, 1, 1), datetime(1995, 1, 1)]})
+        >>> [round(v, 4) for v in f.compute(ts, st).tolist()]
+        [29.9986, 26.0014]
+    """
+
+    OUTPUT_MODALITY: DataModality = DataModality.UNIVARIATE_REGRESSION
+
+    def __init__(self, dob_col: str):
+        self.dob_col = dob_col
+        self.link_static_cols = [dob_col]
+
+    def compute(self, timestamps: pd.Series, static_row_df: pd.DataFrame) -> pd.Series:
+        dob = pd.to_datetime(static_row_df[self.dob_col])
+        ts = pd.to_datetime(timestamps)
+        delta_s = (ts.values - dob.values).astype("timedelta64[us]").astype(np.int64) / 1e6
+        return pd.Series(delta_s / (60 * 60 * 24 * 365.25), index=timestamps.index)
+
+    def update_from_prior_timepoint(
+        self,
+        prior_indices,
+        prior_values,
+        new_delta,
+        new_time,
+        vocab: Vocabulary | None,
+        measurement_metadata: pd.Series | None,
+    ):
+        """De-normalizes the prior age, advances it by ``new_delta``, re-normalizes.
+
+        Out-of-bounds new ages (per the fit outlier thresholds) become NaN,
+        matching the reference's torch update
+        (``time_dependent_functor.py:149-225``).
+        """
+        mean = float(measurement_metadata["normalizer"]["mean_"])
+        std = float(measurement_metadata["normalizer"]["std_"])
+        thresh_large = measurement_metadata["outlier_model"]["thresh_large_"]
+        thresh_small = measurement_metadata["outlier_model"]["thresh_small_"]
+
+        prior_age = prior_values * std + mean
+        new_age = prior_age + new_delta / MINUTES_PER_YEAR
+
+        oob = jnp.zeros_like(new_age, dtype=bool)
+        if thresh_large is not None and not pd.isna(thresh_large):
+            oob = oob | (new_age > float(thresh_large))
+        if thresh_small is not None and not pd.isna(thresh_small):
+            oob = oob | (new_age < float(thresh_small))
+        new_age = jnp.where(oob, jnp.nan, new_age)
+
+        return prior_indices, (new_age - mean) / std
+
+
+class TimeOfDayFunctor(TimeDependentFunctor):
+    """Categorizes the event time into EARLY_AM / AM / PM / LATE_PM.
+
+    Reference: ``time_dependent_functor.py:228-332``. Buckets: hour < 6 →
+    EARLY_AM, < 12 → AM, < 21 → PM, else LATE_PM.
+
+    Examples:
+        >>> import pandas as pd
+        >>> from datetime import datetime
+        >>> f = TimeOfDayFunctor()
+        >>> ts = pd.Series([datetime(2020, 1, 1, 0), datetime(2020, 1, 1, 6),
+        ...                 datetime(2020, 1, 1, 12), datetime(2020, 1, 1, 23)])
+        >>> f.compute(ts, None).tolist()
+        ['EARLY_AM', 'AM', 'PM', 'LATE_PM']
+    """
+
+    OUTPUT_MODALITY: DataModality = DataModality.SINGLE_LABEL_CLASSIFICATION
+
+    def compute(self, timestamps: pd.Series, static_row_df: pd.DataFrame | None) -> pd.Series:
+        hours = pd.to_datetime(timestamps).dt.hour
+        return pd.Series(
+            np.select(
+                [hours < 6, hours < 12, hours < 21],
+                ["EARLY_AM", "AM", "PM"],
+                default="LATE_PM",
+            ),
+            index=timestamps.index,
+        )
+
+    def update_from_prior_timepoint(
+        self,
+        prior_indices,
+        prior_values,
+        new_delta,
+        new_time,
+        vocab: Vocabulary | None,
+        measurement_metadata: pd.Series | None,
+    ):
+        """Maps new absolute times (minutes since epoch) to time-of-day indices."""
+        hrs_local_at_midnight_epoch = datetime(1970, 1, 1).timestamp() / 60 / 60
+
+        new_hour_utc = new_time / 60
+        new_hour_local = (new_hour_utc - hrs_local_at_midnight_epoch) % 24
+
+        early_am = vocab.idxmap.get("EARLY_AM", 0)
+        am = vocab.idxmap.get("AM", 0)
+        pm = vocab.idxmap.get("PM", 0)
+        late_pm = vocab.idxmap.get("LATE_PM", 0)
+
+        new_indices = jnp.where(
+            new_hour_local < 6,
+            early_am,
+            jnp.where(new_hour_local < 12, am, jnp.where(new_hour_local < 21, pm, late_pm)),
+        )
+        return new_indices, jnp.nan * prior_values

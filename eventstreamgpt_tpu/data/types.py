@@ -1,0 +1,242 @@
+"""Core data-model types: modality/temporality enums and the device batch.
+
+TPU-native re-design of ``/root/reference/EventStream/data/types.py``. The
+reference's ``PytorchBatch`` (``types.py:87``) is a mutable dataclass of torch
+tensors with dynamic per-batch shapes; here the batch is a frozen
+``flax.struct`` pytree of arrays with **static shapes** so it can flow through
+``jax.jit`` / ``pjit`` / ``lax.scan`` unchanged. Dynamic-shape helpers the
+reference implements as tensor surgery (``repeat_batch_elements`` ``:318``,
+``split_repeated_batch`` ``:469``) become pure jnp reshapes.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..utils import StrEnum
+
+
+def de_pad(L: list[int], *other_L) -> list[int] | tuple[list[int], ...]:
+    """Filters all passed lists to indices where the first list is non-zero.
+
+    Reference contract: ``data/types.py:14``.
+
+    Examples:
+        >>> de_pad([1, 3, 0, 4, 0, 0], [10, 0, 5, 8, 1, 0])
+        ([1, 3, 4], [10, 0, 8])
+        >>> de_pad([1, 3, 0, 4, 0, 0])
+        [1, 3, 4]
+    """
+    out_L = []
+    out_other: list[list | None] = [None if x is None else [] for x in other_L]
+    for i, v in enumerate(L):
+        if v != 0:
+            out_L.append(v)
+            for j, LL in enumerate(other_L):
+                if LL is not None:
+                    out_other[j].append(LL[i])
+    if other_L:
+        return tuple([out_L] + out_other)
+    return out_L
+
+
+class InputDFType(StrEnum):
+    """The kinds of input dataframes usable to construct a dataset."""
+
+    STATIC = enum.auto()
+    EVENT = enum.auto()
+    RANGE = enum.auto()
+
+
+class InputDataType(StrEnum):
+    """The kinds of data an input dataframe column can contain."""
+
+    CATEGORICAL = enum.auto()
+    FLOAT = enum.auto()
+    TIMESTAMP = enum.auto()
+    BOOLEAN = enum.auto()
+
+
+class TemporalityType(StrEnum):
+    """The ways a measurement can vary in time (reference: ``types.py:802``)."""
+
+    STATIC = enum.auto()
+    DYNAMIC = enum.auto()
+    FUNCTIONAL_TIME_DEPENDENT = enum.auto()
+
+
+class DataModality(StrEnum):
+    """The modality of a data element (reference: ``types.py:826``)."""
+
+    DROPPED = enum.auto()
+    SINGLE_LABEL_CLASSIFICATION = enum.auto()
+    MULTI_LABEL_CLASSIFICATION = enum.auto()
+    MULTIVARIATE_REGRESSION = enum.auto()
+    UNIVARIATE_REGRESSION = enum.auto()
+
+
+class NumericDataModalitySubtype(StrEnum):
+    """Numeric value subtypes (reference: ``types.py:865``)."""
+
+    DROPPED = enum.auto()
+    INTEGER = enum.auto()
+    FLOAT = enum.auto()
+    CATEGORICAL_INTEGER = enum.auto()
+    CATEGORICAL_FLOAT = enum.auto()
+
+
+Array = Any  # jnp.ndarray or np.ndarray — batches are host-built then device-put.
+
+
+@struct.dataclass
+class EventStreamBatch:
+    """A static-shape batch of event-stream data, registered as a JAX pytree.
+
+    Field names and shapes mirror the reference ``PytorchBatch``
+    (``/root/reference/EventStream/data/types.py:87-163``) so the data contract
+    is identical; the representation differs in being immutable and pytree-
+    flattenable so whole batches move through ``jit`` boundaries, shardings,
+    and scans without host sync.
+
+    Shapes (``B`` batch, ``L`` sequence length, ``M`` dynamic data elements,
+    ``S`` static data elements):
+
+    * ``event_mask``: bool ``(B, L)`` — True for real (non-padding) events.
+    * ``time_delta``: float ``(B, L)`` — minutes to the *next* event.
+    * ``time``: float ``(B, L)`` — minutes since sequence start (optional).
+    * ``static_indices`` / ``static_measurement_indices``: int ``(B, S)``.
+    * ``dynamic_indices`` / ``dynamic_measurement_indices``: int ``(B, L, M)``.
+    * ``dynamic_values``: float ``(B, L, M)``; ``dynamic_values_mask``: bool.
+    * ``start_time``: float ``(B,)`` minutes since epoch (generation only).
+    * ``start_idx`` / ``end_idx`` / ``subject_id``: int ``(B,)`` (optional).
+    * ``stream_labels``: dict of per-task label arrays ``(B,)`` (optional).
+    """
+
+    event_mask: Optional[Array] = None
+    time_delta: Optional[Array] = None
+    time: Optional[Array] = None
+
+    static_indices: Optional[Array] = None
+    static_measurement_indices: Optional[Array] = None
+
+    dynamic_indices: Optional[Array] = None
+    dynamic_measurement_indices: Optional[Array] = None
+    dynamic_values: Optional[Array] = None
+    dynamic_values_mask: Optional[Array] = None
+
+    start_time: Optional[Array] = None
+    start_idx: Optional[Array] = None
+    end_idx: Optional[Array] = None
+    subject_id: Optional[Array] = None
+
+    stream_labels: Optional[dict[str, Array]] = None
+
+    # -- dict-like conveniences matching the reference API ------------------
+    def keys(self):
+        return (f.name for f in self.__dataclass_fields__.values())
+
+    def get(self, item: str, default: Any = None) -> Any:
+        v = getattr(self, item, None)
+        return default if v is None else v
+
+    def __getitem__(self, item):
+        if isinstance(item, str):
+            return getattr(self, item)
+        return self.slice(item)
+
+    @property
+    def batch_size(self) -> int:
+        return self.event_mask.shape[0]
+
+    @property
+    def sequence_length(self) -> int:
+        return self.event_mask.shape[1]
+
+    @property
+    def n_data_elements(self) -> int:
+        return self.dynamic_indices.shape[2]
+
+    @property
+    def n_static_data_elements(self) -> int:
+        return self.static_indices.shape[1]
+
+    def slice(self, index) -> "EventStreamBatch":
+        """Slices batch (dim 0), sequence (dim 1), and data-element (dim 2) axes.
+
+        Mirrors ``PytorchBatch._slice`` (``types.py:209``).
+        """
+        if not isinstance(index, tuple):
+            index = (index,)
+        if len(index) == 0 or len(index) > 3:
+            raise ValueError(f"Invalid index {index}: must have 1-3 elements.")
+        b = index[0]
+        s = index[1] if len(index) > 1 else slice(None)
+        m = index[2] if len(index) > 2 else slice(None)
+
+        def _b(x):
+            return None if x is None else x[b]
+
+        return EventStreamBatch(
+            event_mask=self.event_mask[b, s],
+            time_delta=None if self.time_delta is None else self.time_delta[b, s],
+            time=None if self.time is None else self.time[b, s],
+            static_indices=_b(self.static_indices),
+            static_measurement_indices=_b(self.static_measurement_indices),
+            dynamic_indices=self.dynamic_indices[b, s, m],
+            dynamic_measurement_indices=self.dynamic_measurement_indices[b, s, m],
+            dynamic_values=self.dynamic_values[b, s, m],
+            dynamic_values_mask=self.dynamic_values_mask[b, s, m],
+            start_time=_b(self.start_time),
+            start_idx=_b(self.start_idx),
+            end_idx=_b(self.end_idx),
+            subject_id=_b(self.subject_id),
+            stream_labels=(
+                None if self.stream_labels is None else {k: v[b] for k, v in self.stream_labels.items()}
+            ),
+        )
+
+    def last_sequence_element_unsqueezed(self) -> "EventStreamBatch":
+        """The last event of each sequence, retaining the sequence dim."""
+        return self.slice((slice(None), slice(-1, None)))
+
+    def repeat_batch_elements(self, expand_size: int) -> "EventStreamBatch":
+        """Repeats each batch element ``expand_size`` times, in order.
+
+        Reference: ``PytorchBatch.repeat_batch_elements`` (``types.py:318``).
+        Implemented as a pure ``jnp.repeat`` over every pytree leaf, so it is
+        jit-safe (``expand_size`` is static).
+        """
+
+        def rep(x):
+            return None if x is None else jnp.repeat(x, expand_size, axis=0)
+
+        return jax.tree_util.tree_map(rep, self)
+
+    def split_repeated_batch(self, n_splits: int) -> list["EventStreamBatch"]:
+        """Inverse of `repeat_batch_elements`: regroups samples per source element.
+
+        Returns ``n_splits`` batches; the i-th batch holds the i-th repeated
+        sample of each original element (reference: ``types.py:469``).
+        """
+
+        def sel(x, i):
+            if x is None:
+                return None
+            reshaped = x.reshape((x.shape[0] // n_splits, n_splits) + x.shape[1:])
+            return reshaped[:, i]
+
+        return [jax.tree_util.tree_map(lambda x, i=i: sel(x, i), self) for i in range(n_splits)]
+
+    def to_numpy(self) -> "EventStreamBatch":
+        """Converts all leaves to host numpy arrays (for labelers/writers)."""
+        return jax.tree_util.tree_map(lambda x: np.asarray(x), self)
+
+    def with_fields(self, **updates: Any) -> "EventStreamBatch":
+        """Returns a copy with the given fields replaced."""
+        return self.replace(**updates)

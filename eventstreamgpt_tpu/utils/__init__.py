@@ -1,0 +1,41 @@
+from .config_tool import (
+    CONFIG_STORE,
+    config_dataclass,
+    load_config,
+    main_entry,
+    parse_overrides,
+    resolve_interpolations,
+    structure,
+    unstructure,
+)
+from .enums import StrEnum
+from .misc import (
+    COUNT_OR_PROPORTION,
+    SeedableMixin,
+    TimeableMixin,
+    count_or_proportion,
+    lt_count_or_proportion,
+    num_initial_spaces,
+    to_dict_flat,
+)
+from .serialization import JSONableMixin
+
+__all__ = [
+    "CONFIG_STORE",
+    "COUNT_OR_PROPORTION",
+    "JSONableMixin",
+    "SeedableMixin",
+    "StrEnum",
+    "TimeableMixin",
+    "config_dataclass",
+    "count_or_proportion",
+    "load_config",
+    "lt_count_or_proportion",
+    "main_entry",
+    "num_initial_spaces",
+    "parse_overrides",
+    "resolve_interpolations",
+    "structure",
+    "to_dict_flat",
+    "unstructure",
+]

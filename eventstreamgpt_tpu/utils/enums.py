@@ -1,0 +1,43 @@
+"""String-valued enums used throughout the framework.
+
+TPU-native re-design of the enum utilities the reference keeps in
+``EventStream/utils.py:139`` (``StrEnum``). Pure Python; no accelerator
+dependence.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class StrEnum(str, enum.Enum):
+    """An enum whose members are (and serialize as) lowercase strings.
+
+    ``enum.auto()`` resolves to the lowercased member name, matching the
+    behavior of the reference's backported ``StrEnum``
+    (``/root/reference/EventStream/utils.py:139-213``) so that on-disk JSON
+    configs remain interchangeable.
+
+    Examples:
+        >>> class Color(StrEnum):
+        ...     RED = enum.auto()
+        ...     DARK_BLUE = enum.auto()
+        >>> Color.RED.value
+        'red'
+        >>> str(Color.DARK_BLUE)
+        'dark_blue'
+        >>> Color("red") is Color.RED
+        True
+    """
+
+    def __str__(self) -> str:
+        return str(self.value)
+
+    @staticmethod
+    def _generate_next_value_(name, start, count, last_values) -> str:
+        return name.lower()
+
+    @classmethod
+    def values(cls) -> list[str]:
+        """Returns all member values of this enum."""
+        return list(map(lambda c: c.value, cls))

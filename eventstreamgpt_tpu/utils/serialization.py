@@ -1,0 +1,80 @@
+"""JSON round-tripping for config objects.
+
+Re-designed equivalent of the reference's ``JSONableMixin``
+(``/root/reference/EventStream/utils.py:214-363``). Every config object in the
+framework serializes to plain JSON so that run artifacts (``config.json``,
+``vocabulary_config.json`` etc.) keep the same on-disk contract as the
+reference implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from pathlib import Path
+from typing import Any, TypeVar
+
+T = TypeVar("T", bound="JSONableMixin")
+
+
+def _jsonify(obj: Any) -> Any:
+    """Recursively converts an object into JSON-compatible primitives."""
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, JSONableMixin):
+        return obj.to_dict()
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _jsonify(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if isinstance(obj, Path):
+        return str(obj)
+    return obj
+
+
+class JSONableMixin:
+    """Mixin granting ``to_dict``/``from_dict``/``to_json_file``/``from_json_file``.
+
+    Dataclass subclasses get ``to_dict`` for free; other classes must override.
+
+    Examples:
+        >>> import dataclasses
+        >>> @dataclasses.dataclass
+        ... class MyData(JSONableMixin):
+        ...     name: str
+        >>> MyData("hi").to_dict()
+        {'name': 'hi'}
+        >>> MyData.from_dict({'name': 'hi'})
+        MyData(name='hi')
+    """
+
+    @classmethod
+    def from_dict(cls: type[T], as_dict: dict) -> T:
+        """Constructs this class from a dictionary of constructor kwargs."""
+        return cls(**as_dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        """Returns a plain-JSON dictionary representation of this object."""
+        if dataclasses.is_dataclass(self):
+            out = {}
+            for f in dataclasses.fields(self):
+                out[f.name] = _jsonify(getattr(self, f.name))
+            return out
+        raise NotImplementedError("This must be overwritten in non-dataclass derived classes!")
+
+    def to_json_file(self, fp: Path | str, do_overwrite: bool = False) -> None:
+        """Writes this object's dict form to ``fp`` as JSON."""
+        fp = Path(fp)
+        if fp.exists() and not do_overwrite:
+            raise FileExistsError(f"{fp} exists and do_overwrite = {do_overwrite}")
+        fp.parent.mkdir(parents=True, exist_ok=True)
+        fp.write_text(json.dumps(self.to_dict()))
+
+    @classmethod
+    def from_json_file(cls: type[T], fp: Path | str) -> T:
+        """Reads an object of this class from the JSON file at ``fp``."""
+        with open(fp) as f:
+            return cls.from_dict(json.load(f))

@@ -1,0 +1,122 @@
+"""Tests for data types (EventStreamBatch pytree) and Vocabulary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.data import EventStreamBatch, Vocabulary, de_pad
+
+
+def make_batch() -> EventStreamBatch:
+    return EventStreamBatch(
+        event_mask=jnp.array([[True, True, True], [True, True, False]]),
+        time_delta=jnp.array([[1.0, 2.0, 3.0], [1.0, 5.0, 0.0]]),
+        static_indices=jnp.array([[1, 2], [3, 0]]),
+        static_measurement_indices=jnp.array([[1, 1], [2, 0]]),
+        dynamic_indices=jnp.array([[[7, 8], [9, 0], [8, 7]], [[8, 7], [8, 9], [0, 0]]]),
+        dynamic_measurement_indices=jnp.array([[[4, 4], [5, 0], [4, 4]], [[4, 4], [4, 5], [0, 0]]]),
+        dynamic_values=jnp.array([[[1.0, 2.0], [0, 0], [1.1, 2.1]], [[5, 6.0], [7, 0], [0, 0]]]),
+        dynamic_values_mask=jnp.array(
+            [[[True, True], [False, False], [True, True]], [[True, True], [True, False], [False, False]]]
+        ),
+    )
+
+
+def test_de_pad():
+    assert de_pad([1, 3, 0, 4, 0, 0], [10, 0, 5, 8, 1, 0]) == ([1, 3, 4], [10, 0, 8])
+    assert de_pad([1, 3, 0, 4, 0, 0]) == [1, 3, 4]
+
+
+def test_batch_is_pytree():
+    batch = make_batch()
+    leaves = jax.tree_util.tree_leaves(batch)
+    assert len(leaves) == 8
+    mapped = jax.tree_util.tree_map(lambda x: x, batch)
+    assert isinstance(mapped, EventStreamBatch)
+
+
+def test_batch_properties_and_getitem():
+    batch = make_batch()
+    assert batch.batch_size == 2
+    assert batch.sequence_length == 3
+    assert batch.n_data_elements == 2
+    assert batch.n_static_data_elements == 2
+    np.testing.assert_array_equal(batch["event_mask"], batch.event_mask)
+
+
+def test_batch_slicing():
+    batch = make_batch()
+    sliced = batch[:, -1:]
+    assert sliced.event_mask.shape == (2, 1)
+    assert sliced.dynamic_indices.shape == (2, 1, 2)
+    # Static data is not sequence-sliced.
+    assert sliced.static_indices.shape == (2, 2)
+    last = batch.last_sequence_element_unsqueezed()
+    np.testing.assert_array_equal(last.time_delta, batch.time_delta[:, -1:])
+
+
+def test_batch_repeat_and_split_roundtrip():
+    batch = make_batch()
+    rep = batch.repeat_batch_elements(3)
+    assert rep.batch_size == 6
+    # Repeats are in-order per element: [b0, b0, b0, b1, b1, b1].
+    np.testing.assert_array_equal(rep.time_delta[0], rep.time_delta[2])
+    np.testing.assert_array_equal(rep.time_delta[0], batch.time_delta[0])
+    np.testing.assert_array_equal(rep.time_delta[3], batch.time_delta[1])
+
+    splits = rep.split_repeated_batch(3)
+    assert len(splits) == 3
+    for s in splits:
+        np.testing.assert_array_equal(np.asarray(s.time_delta), np.asarray(batch.time_delta))
+
+
+def test_batch_jit_through():
+    batch = make_batch()
+
+    @jax.jit
+    def total_events(b: EventStreamBatch):
+        return b.event_mask.sum()
+
+    assert int(total_events(batch)) == 5
+
+
+def test_vocabulary_sorting_and_lookup():
+    vocab = Vocabulary(vocabulary=["apple", "banana", "UNK"], obs_frequencies=[3, 5, 2])
+    assert vocab.vocabulary == ["UNK", "banana", "apple"]
+    assert vocab.obs_frequencies == [0.2, 0.5, 0.3]
+    assert vocab.idxmap == {"UNK": 0, "banana": 1, "apple": 2}
+    assert vocab[1] == "banana"
+    assert vocab["apple"] == 2
+    assert vocab["not-present"] == 0
+    assert len(vocab) == 3
+    with pytest.raises(TypeError):
+        vocab[3.4]
+
+
+def test_vocabulary_validation():
+    with pytest.raises(ValueError, match="Empty"):
+        Vocabulary(vocabulary=[], obs_frequencies=[])
+    with pytest.raises(ValueError, match="same length"):
+        Vocabulary(vocabulary=["apple"], obs_frequencies=[1, 2])
+    with pytest.raises(ValueError, match="duplicates"):
+        Vocabulary(vocabulary=["apple", "apple"], obs_frequencies=[1, 2])
+    with pytest.raises(ValueError, match="Integer"):
+        Vocabulary(vocabulary=["apple", 1], obs_frequencies=[1, 2])
+
+
+def test_vocabulary_filter():
+    vocab = Vocabulary(vocabulary=["apple", "banana", "UNK"], obs_frequencies=[5, 3, 2])
+    vocab.filter(total_observations=10, min_valid_element_freq=0.4)
+    assert vocab.vocabulary == ["UNK", "apple"]
+    assert vocab.obs_frequencies == [0.5, 0.5]
+    # idxmap cache invalidated.
+    assert vocab.idxmap == {"UNK": 0, "apple": 1}
+
+
+def test_vocabulary_describe(capsys):
+    vocab = Vocabulary(vocabulary=["apple", "banana", "pear", "UNK"], obs_frequencies=[3, 4, 1, 2])
+    vocab.describe(n_head=2, n_tail=1, wrap_lines=False)
+    out = capsys.readouterr().out
+    assert "4 elements, 20.0% UNKs" in out
+    assert "banana" in out

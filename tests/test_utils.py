@@ -1,0 +1,125 @@
+"""Tests for eventstreamgpt_tpu.utils (enums, serialization, config tool)."""
+
+import dataclasses
+import enum
+import json
+from pathlib import Path
+
+import pytest
+
+from eventstreamgpt_tpu.utils import (
+    CONFIG_STORE,
+    JSONableMixin,
+    StrEnum,
+    config_dataclass,
+    count_or_proportion,
+    load_config,
+    lt_count_or_proportion,
+    parse_overrides,
+    resolve_interpolations,
+    to_dict_flat,
+    unstructure,
+)
+
+
+class Color(StrEnum):
+    RED = enum.auto()
+    DARK_BLUE = enum.auto()
+
+
+def test_str_enum():
+    assert Color.RED.value == "red"
+    assert str(Color.DARK_BLUE) == "dark_blue"
+    assert Color("red") is Color.RED
+    assert Color.values() == ["red", "dark_blue"]
+    assert json.dumps(Color.RED) == '"red"'
+
+
+def test_count_or_proportion():
+    assert count_or_proportion(100, 0.1) == 10
+    assert count_or_proportion(None, 11) == 11
+    assert count_or_proportion(100, 0.116) == 12
+    with pytest.raises(ValueError):
+        count_or_proportion(None, 0)
+    with pytest.raises(ValueError):
+        count_or_proportion(None, 1.3)
+    with pytest.raises(TypeError):
+        count_or_proportion(None, "a")
+
+
+def test_lt_count_or_proportion():
+    assert not lt_count_or_proportion(10, 0.1, 100)
+    assert lt_count_or_proportion(10, 0.11, 100)
+    assert lt_count_or_proportion(10, 11)
+    assert not lt_count_or_proportion(10, 9)
+    assert not lt_count_or_proportion(10, None)
+
+
+@dataclasses.dataclass
+class _Inner(JSONableMixin):
+    x: int = 1
+    color: Color = Color.RED
+
+
+@dataclasses.dataclass
+class _Outer(JSONableMixin):
+    name: str = "hi"
+    inner: _Inner = dataclasses.field(default_factory=_Inner)
+
+
+def test_jsonable_roundtrip(tmp_path: Path):
+    obj = _Outer(name="yo", inner=_Inner(x=5, color=Color.DARK_BLUE))
+    d = obj.to_dict()
+    assert d == {"name": "yo", "inner": {"x": 5, "color": "dark_blue"}}
+    fp = tmp_path / "o.json"
+    obj.to_json_file(fp)
+    loaded = json.loads(fp.read_text())
+    assert loaded == d
+    with pytest.raises(FileExistsError):
+        obj.to_json_file(fp)
+
+
+@config_dataclass
+class MySweepConfig:
+    lr: float = 1e-3
+    steps: int = 100
+    name: str = "run"
+    nested: dict = dataclasses.field(default_factory=dict)
+
+
+def test_config_store_registration():
+    assert "my_sweep_config" in CONFIG_STORE
+    assert CONFIG_STORE["my_sweep_config"] is MySweepConfig
+
+
+def test_parse_overrides():
+    out = parse_overrides(["a.b=3", "c=hello", "d=[1,2]", "e=null", "f=0.5"])
+    assert out == {"a": {"b": 3}, "c": "hello", "d": [1, 2], "e": None, "f": 0.5}
+
+
+def test_load_config_with_yaml_and_overrides(tmp_path: Path):
+    yaml_fp = tmp_path / "cfg.yaml"
+    yaml_fp.write_text("lr: 0.01\nname: from_yaml\nnested:\n  k: v\n")
+    cfg = load_config(MySweepConfig, yaml_file=yaml_fp, overrides=["steps=7", "lr=0.1"])
+    assert cfg.lr == 0.1
+    assert cfg.steps == 7
+    assert cfg.name == "from_yaml"
+    assert cfg.nested == {"k": "v"}
+
+
+def test_interpolation():
+    d = {"base": "/tmp/x", "sub": "${base}/y", "deep": {"z": "${sub}/z"}}
+    out = resolve_interpolations(d)
+    assert out["sub"] == "/tmp/x/y"
+    assert out["deep"]["z"] == "/tmp/x/y/z"
+
+
+def test_now_interpolation():
+    out = resolve_interpolations({"d": "${now:%Y}"})
+    assert len(out["d"]) == 4 and out["d"].isdigit()
+
+
+def test_unstructure_and_flat():
+    obj = _Outer()
+    assert unstructure(obj) == {"name": "hi", "inner": {"x": 1, "color": "red"}}
+    assert to_dict_flat({"a": {"b": 1}, "c": 2}) == {"a.b": 1, "c": 2}
