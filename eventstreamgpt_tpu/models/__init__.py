@@ -1,0 +1,14 @@
+"""Model layer: configs, embedding, transformer encoders, heads, full models."""
+
+from .config import (  # noqa: F401
+    AttentionLayerType,
+    StructuredEventProcessingMode,
+    StructuredTransformerConfig,
+    TimeToEventGenerationHeadType,
+)
+from .embedding import (  # noqa: F401
+    DataEmbeddingLayer,
+    EmbeddingMode,
+    MeasIndexGroupOptions,
+    StaticEmbeddingMode,
+)

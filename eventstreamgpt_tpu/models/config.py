@@ -1,0 +1,547 @@
+"""Model architecture configuration for structured event-stream transformers.
+
+TPU-native rebuild of ``/root/reference/EventStream/transformer/config.py:355``
+(``StructuredTransformerConfig``). Field names, defaults, and validation match
+the reference so existing YAML/JSON configs keep working (BASELINE
+requirement), but the class is a plain ``JSONableMixin`` python object — no
+HuggingFace ``PretrainedConfig`` coupling. HF-inherited task fields the
+codebase actually uses (``finetuning_task``, ``id2label``, ``label2id``,
+``num_labels``, ``problem_type``, ``task_specific_params``) are first-class
+fields here.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import math
+from typing import Any, Hashable, Union
+
+from ..data.config import MeasurementConfig
+from ..data.types import DataModality
+from ..utils import JSONableMixin, StrEnum, config_dataclass
+from .embedding import MEAS_INDEX_GROUP_T, MeasIndexGroupOptions, StaticEmbeddingMode
+
+
+class StructuredEventProcessingMode(StrEnum):
+    """Structured event sequence processing modes (reference ``config.py:314``)."""
+
+    CONDITIONALLY_INDEPENDENT = enum.auto()
+    NESTED_ATTENTION = enum.auto()
+
+
+class TimeToEventGenerationHeadType(StrEnum):
+    """Options for model TTE generation heads (reference ``config.py:324``)."""
+
+    EXPONENTIAL = enum.auto()
+    LOG_NORMAL_MIXTURE = enum.auto()
+
+
+class AttentionLayerType(StrEnum):
+    """Attention layer type options (reference ``config.py:334``)."""
+
+    GLOBAL = enum.auto()
+    LOCAL = enum.auto()
+
+
+ATTENTION_TYPES_LIST_T = Union[str, list]
+
+
+class StructuredTransformerConfig(JSONableMixin):
+    """Configuration for event-stream transformer models.
+
+    See the reference docstring (``transformer/config.py:356-478``) for the
+    full field semantics; this class reproduces them. Constructor signature and
+    validation behavior are parity-tested against the reference.
+    """
+
+    def __init__(
+        self,
+        # Data configuration
+        vocab_sizes_by_measurement: dict[str, int] | None = None,
+        vocab_offsets_by_measurement: dict[str, int] | None = None,
+        measurement_configs: dict[str, MeasurementConfig] | None = None,
+        measurements_idxmap: dict[str, dict[Hashable, int]] | None = None,
+        measurements_per_generative_mode: dict[str, list[str]] | None = None,
+        event_types_idxmap: dict[str, int] | None = None,
+        measurements_per_dep_graph_level: list[list[MEAS_INDEX_GROUP_T]] | None = None,
+        max_seq_len: int = 256,
+        do_split_embeddings: bool = False,
+        categorical_embedding_dim: int | None = None,
+        numerical_embedding_dim: int | None = None,
+        static_embedding_mode: str = StaticEmbeddingMode.SUM_ALL,
+        static_embedding_weight: float = 0.5,
+        dynamic_embedding_weight: float = 0.5,
+        categorical_embedding_weight: float = 0.5,
+        numerical_embedding_weight: float = 0.5,
+        do_normalize_by_measurement_index: bool = False,
+        # Model configuration
+        structured_event_processing_mode: str = StructuredEventProcessingMode.CONDITIONALLY_INDEPENDENT,
+        hidden_size: int | None = None,
+        head_dim: int | None = 64,
+        num_hidden_layers: int = 2,
+        num_attention_heads: int = 4,
+        seq_attention_types: ATTENTION_TYPES_LIST_T | None = None,
+        seq_window_size: int = 32,
+        dep_graph_attention_types: ATTENTION_TYPES_LIST_T | None = None,
+        dep_graph_window_size: int | None = 2,
+        intermediate_size: int = 32,
+        activation_function: str = "gelu",
+        attention_dropout: float = 0.1,
+        input_dropout: float = 0.1,
+        resid_dropout: float = 0.1,
+        init_std: float = 0.02,
+        layer_norm_epsilon: float = 1e-5,
+        do_full_block_in_dep_graph_attention: bool | None = True,
+        do_full_block_in_seq_attention: bool | None = False,
+        # Model output configuration
+        TTE_generation_layer_type: str = TimeToEventGenerationHeadType.EXPONENTIAL,
+        TTE_lognormal_generation_num_components: int | None = None,
+        mean_log_inter_event_time_min: float | None = None,
+        std_log_inter_event_time_min: float | None = None,
+        # For decoding
+        use_cache: bool = True,
+        # Task (HF-PretrainedConfig-inherited in the reference)
+        finetuning_task: str | None = None,
+        id2label: dict[int, str] | None = None,
+        label2id: dict[str, int] | None = None,
+        num_labels: int | None = None,
+        problem_type: str | None = None,
+        task_specific_params: dict[str, Any] | None = None,
+        **kwargs,
+    ):
+        if vocab_sizes_by_measurement is None:
+            vocab_sizes_by_measurement = {}
+        if vocab_offsets_by_measurement is None:
+            vocab_offsets_by_measurement = {}
+        if measurements_idxmap is None:
+            measurements_idxmap = {}
+        if measurements_per_generative_mode is None:
+            measurements_per_generative_mode = {}
+        if event_types_idxmap is None:
+            event_types_idxmap = {}
+        if measurement_configs is None:
+            measurement_configs = {}
+
+        self.event_types_idxmap = event_types_idxmap
+
+        if measurement_configs:
+            measurement_configs = {
+                k: (MeasurementConfig.from_dict(v) if type(v) is dict else v)
+                for k, v in measurement_configs.items()
+            }
+        self.measurement_configs = measurement_configs
+
+        if do_split_embeddings:
+            for nm, v in (
+                ("categorical_embedding_dim", categorical_embedding_dim),
+                ("numerical_embedding_dim", numerical_embedding_dim),
+            ):
+                if type(v) is not int or v <= 0:
+                    raise ValueError(
+                        f"When do_split_embeddings={do_split_embeddings}, {nm} must be "
+                        f"a positive integer. Got {v}."
+                    )
+        else:
+            if categorical_embedding_dim is not None:
+                print(
+                    f"WARNING: categorical_embedding_dim is set to {categorical_embedding_dim} but "
+                    f"do_split_embeddings={do_split_embeddings}. Setting categorical_embedding_dim to None."
+                )
+                categorical_embedding_dim = None
+            if numerical_embedding_dim is not None:
+                print(
+                    f"WARNING: numerical_embedding_dim is set to {numerical_embedding_dim} but "
+                    f"do_split_embeddings={do_split_embeddings}. Setting numerical_embedding_dim to None."
+                )
+                numerical_embedding_dim = None
+        self.do_split_embeddings = do_split_embeddings
+
+        self.categorical_embedding_dim = categorical_embedding_dim
+        self.numerical_embedding_dim = numerical_embedding_dim
+        self.static_embedding_mode = StaticEmbeddingMode(static_embedding_mode)
+        self.static_embedding_weight = static_embedding_weight
+        self.dynamic_embedding_weight = dynamic_embedding_weight
+        self.categorical_embedding_weight = categorical_embedding_weight
+        self.numerical_embedding_weight = numerical_embedding_weight
+        self.do_normalize_by_measurement_index = do_normalize_by_measurement_index
+
+        missing_param_err_tmpl = f"For a {structured_event_processing_mode} model, {{}} should not be None"
+        extra_param_err_tmpl = (
+            f"WARNING: For a {structured_event_processing_mode} model, {{}} is not used; got {{}}. Setting "
+            "to None."
+        )
+        if structured_event_processing_mode == StructuredEventProcessingMode.NESTED_ATTENTION:
+            if do_full_block_in_seq_attention is None:
+                raise ValueError(missing_param_err_tmpl.format("do_full_block_in_seq_attention"))
+            if do_full_block_in_dep_graph_attention is None:
+                raise ValueError(missing_param_err_tmpl.format("do_full_block_in_dep_graph_attention"))
+            if measurements_per_dep_graph_level is None:
+                raise ValueError(missing_param_err_tmpl.format("measurements_per_dep_graph_level"))
+
+            proc_levels = []
+            for group in measurements_per_dep_graph_level:
+                proc_group = []
+                for meas_index in group:
+                    if isinstance(meas_index, str):
+                        proc_group.append(meas_index)
+                    elif (
+                        isinstance(meas_index, (list, tuple))
+                        and len(meas_index) == 2
+                        and isinstance(meas_index[0], str)
+                    ):
+                        assert meas_index[1] in MeasIndexGroupOptions.values()
+                        proc_group.append((meas_index[0], meas_index[1]))
+                    else:
+                        raise ValueError(f"Invalid `measurements_per_dep_graph_level` entry {meas_index}.")
+                proc_levels.append(proc_group)
+            measurements_per_dep_graph_level = proc_levels
+        elif structured_event_processing_mode == StructuredEventProcessingMode.CONDITIONALLY_INDEPENDENT:
+            if measurements_per_dep_graph_level is not None:
+                print(
+                    extra_param_err_tmpl.format(
+                        "measurements_per_dep_graph_level", measurements_per_dep_graph_level
+                    )
+                )
+                measurements_per_dep_graph_level = None
+            if do_full_block_in_seq_attention is not None:
+                print(
+                    extra_param_err_tmpl.format(
+                        "do_full_block_in_seq_attention", do_full_block_in_seq_attention
+                    )
+                )
+                do_full_block_in_seq_attention = None
+            if do_full_block_in_dep_graph_attention is not None:
+                print(
+                    extra_param_err_tmpl.format(
+                        "do_full_block_in_dep_graph_attention", do_full_block_in_dep_graph_attention
+                    )
+                )
+                do_full_block_in_dep_graph_attention = None
+            if dep_graph_attention_types is not None:
+                print(extra_param_err_tmpl.format("dep_graph_attention_types", dep_graph_attention_types))
+                dep_graph_attention_types = None
+            if dep_graph_window_size is not None:
+                print(extra_param_err_tmpl.format("dep_graph_window_size", dep_graph_window_size))
+                dep_graph_window_size = None
+        else:
+            raise ValueError(
+                "`structured_event_processing_mode` must be a valid `StructuredEventProcessingMode` "
+                f"enum member ({StructuredEventProcessingMode.values()}). Got "
+                f"{structured_event_processing_mode}."
+            )
+
+        self.structured_event_processing_mode = structured_event_processing_mode
+
+        if (head_dim is None) and (hidden_size is None):
+            raise ValueError("Must specify at least one of hidden size or head dim!")
+        if hidden_size is None:
+            hidden_size = head_dim * num_attention_heads
+        elif head_dim is None:
+            head_dim = hidden_size // num_attention_heads
+        if head_dim * num_attention_heads != hidden_size:
+            raise ValueError(
+                f"hidden_size must be divisible by num_attention_heads (got `hidden_size`: {hidden_size} "
+                f"and `num_attention_heads`: {num_attention_heads})."
+            )
+
+        if type(num_hidden_layers) is not int:
+            raise TypeError(f"num_hidden_layers must be an int! Got {type(num_hidden_layers)}.")
+        elif num_hidden_layers <= 0:
+            raise ValueError(f"num_hidden_layers must be > 0! Got {num_hidden_layers}.")
+        self.num_hidden_layers = num_hidden_layers
+
+        if seq_attention_types is None:
+            seq_attention_types = ["local", "global"]
+        self.seq_attention_types = seq_attention_types
+        self.seq_attention_layers = self.expand_attention_types_params(seq_attention_types)
+        if len(self.seq_attention_layers) != num_hidden_layers:
+            raise ValueError(
+                "Configuration for module is incorrect. "
+                "It is required that `len(config.seq_attention_layers)` == `config.num_hidden_layers` "
+                f"but is `len(config.seq_attention_layers) = {len(self.seq_attention_layers)}`, "
+                f"`config.num_layers = {num_hidden_layers}`. "
+                "`config.seq_attention_layers` is prepared using `config.seq_attention_types`. "
+                "Please verify the value of `config.seq_attention_types` argument."
+            )
+
+        if structured_event_processing_mode != StructuredEventProcessingMode.CONDITIONALLY_INDEPENDENT:
+            if dep_graph_attention_types is None:
+                dep_graph_attention_types = "global"
+            dep_graph_attention_layers = self.expand_attention_types_params(dep_graph_attention_types)
+            if len(dep_graph_attention_layers) != num_hidden_layers:
+                raise ValueError(
+                    "Configuration for module is incorrect. It is required that "
+                    "`len(config.dep_graph_attention_layers)` == `config.num_hidden_layers` "
+                    f"but is `len(config.dep_graph_attention_layers) = {len(dep_graph_attention_layers)}`, "
+                    f"`config.num_layers = {num_hidden_layers}`. "
+                    "`config.dep_graph_attention_layers` is prepared using "
+                    "`config.dep_graph_attention_types`. Please verify the value of "
+                    "`config.dep_graph_attention_types` argument."
+                )
+        else:
+            dep_graph_attention_layers = None
+        self.dep_graph_attention_types = dep_graph_attention_types
+        self.dep_graph_attention_layers = dep_graph_attention_layers
+
+        self.seq_window_size = seq_window_size
+        self.dep_graph_window_size = dep_graph_window_size
+
+        missing_param_err_tmpl = f"For a {TTE_generation_layer_type} model, {{}} should not be None"
+        extra_param_err_tmpl = (
+            f"WARNING: For a {TTE_generation_layer_type} model, {{}} is not used; got {{}}. "
+            "Setting to None."
+        )
+        if TTE_generation_layer_type == TimeToEventGenerationHeadType.LOG_NORMAL_MIXTURE:
+            if TTE_lognormal_generation_num_components is None:
+                raise ValueError(missing_param_err_tmpl.format("TTE_lognormal_generation_num_components"))
+            if type(TTE_lognormal_generation_num_components) is not int:
+                raise TypeError(
+                    f"`TTE_lognormal_generation_num_components` must be an int! "
+                    f"Got: {type(TTE_lognormal_generation_num_components)}."
+                )
+            elif TTE_lognormal_generation_num_components <= 0:
+                raise ValueError(
+                    "`TTE_lognormal_generation_num_components` should be >0 "
+                    f"got {TTE_lognormal_generation_num_components}."
+                )
+            if mean_log_inter_event_time_min is None:
+                mean_log_inter_event_time_min = 0.0
+            if std_log_inter_event_time_min is None:
+                std_log_inter_event_time_min = 1.0
+        elif TTE_generation_layer_type == TimeToEventGenerationHeadType.EXPONENTIAL:
+            if TTE_lognormal_generation_num_components is not None:
+                print(
+                    extra_param_err_tmpl.format(
+                        "TTE_lognormal_generation_num_components", TTE_lognormal_generation_num_components
+                    )
+                )
+                TTE_lognormal_generation_num_components = None
+            if mean_log_inter_event_time_min is not None:
+                print(
+                    extra_param_err_tmpl.format(
+                        "mean_log_inter_event_time_min", mean_log_inter_event_time_min
+                    )
+                )
+                mean_log_inter_event_time_min = None
+            if std_log_inter_event_time_min is not None:
+                print(
+                    extra_param_err_tmpl.format("std_log_inter_event_time_min", std_log_inter_event_time_min)
+                )
+                std_log_inter_event_time_min = None
+        else:
+            raise ValueError(
+                f"Invalid option for `TTE_generation_layer_type`. Must be in "
+                f"({TimeToEventGenerationHeadType.values()}). Got {TTE_generation_layer_type}."
+            )
+
+        self.TTE_generation_layer_type = TTE_generation_layer_type
+        self.TTE_lognormal_generation_num_components = TTE_lognormal_generation_num_components
+        self.mean_log_inter_event_time_min = mean_log_inter_event_time_min
+        self.std_log_inter_event_time_min = std_log_inter_event_time_min
+
+        self.init_std = init_std
+
+        self.max_seq_len = max_seq_len
+        self.vocab_sizes_by_measurement = vocab_sizes_by_measurement
+        self.vocab_offsets_by_measurement = vocab_offsets_by_measurement
+        self.measurements_idxmap = measurements_idxmap
+        self.measurements_per_generative_mode = measurements_per_generative_mode
+        self.measurements_per_dep_graph_level = measurements_per_dep_graph_level
+
+        self.vocab_size = max(sum(self.vocab_sizes_by_measurement.values()), 1)
+
+        self.head_dim = head_dim
+        self.hidden_size = hidden_size
+        self.num_attention_heads = num_attention_heads
+        self.attention_dropout = attention_dropout
+        self.input_dropout = input_dropout
+        self.resid_dropout = resid_dropout
+        self.intermediate_size = intermediate_size
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.activation_function = activation_function
+        self.do_full_block_in_seq_attention = do_full_block_in_seq_attention
+        self.do_full_block_in_dep_graph_attention = do_full_block_in_dep_graph_attention
+
+        self.use_cache = use_cache
+
+        self.finetuning_task = finetuning_task
+        self.id2label = id2label
+        self.label2id = label2id
+        self.num_labels = num_labels
+        self.problem_type = problem_type
+        self.task_specific_params = task_specific_params
+
+        # Accept-and-store unknown kwargs for forward compatibility, as
+        # PretrainedConfig does.
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._extra_kwargs = sorted(kwargs.keys())
+
+    def measurements_for(self, modality: DataModality) -> list[str]:
+        return self.measurements_per_generative_mode.get(modality, [])
+
+    def expand_attention_types_params(self, attention_types: ATTENTION_TYPES_LIST_T) -> list[str]:
+        """Expands the attention-type mini-language into a per-layer list.
+
+        Reference: ``transformer/config.py:818-837``.
+
+        Examples:
+            >>> cfg = StructuredTransformerConfig(num_hidden_layers=4)
+            >>> cfg.expand_attention_types_params("global")
+            ['global', 'global', 'global', 'global']
+            >>> cfg.expand_attention_types_params(["local", "global"])
+            ['local', 'global', 'local', 'global']
+            >>> cfg.expand_attention_types_params([(["global", "local"], 1), (["global"], 2)])
+            ['global', 'local', 'global', 'global']
+        """
+        if isinstance(attention_types, str):
+            return [attention_types] * self.num_hidden_layers
+        if not isinstance(attention_types, list):
+            raise TypeError(f"Config Invalid {attention_types} ({type(attention_types)}) is wrong type!")
+        if isinstance(attention_types[0], str):
+            return (attention_types * self.num_hidden_layers)[: self.num_hidden_layers]
+        if isinstance(attention_types[0], (list, tuple)):
+            attentions = []
+            for sub_list, n_layers in attention_types:
+                attentions.extend(list(sub_list) * n_layers)
+            return attentions[: self.num_hidden_layers]
+        raise TypeError(f"Config Invalid {attention_types} El 0 ({type(attention_types[0])}) is wrong type!")
+
+    def set_to_dataset(self, dataset) -> None:
+        """Copies vocabulary/idxmap/task information from a dataset.
+
+        Reference: ``transformer/config.py:839-899``. ``dataset`` is any
+        object with the `JaxDataset` attribute surface (``measurement_configs``,
+        ``vocabulary_config``, ``max_seq_len``, TTE stats, task fields).
+        """
+        self.measurement_configs = dataset.measurement_configs
+        self.measurements_idxmap = dataset.vocabulary_config.measurements_idxmap
+        self.measurements_per_generative_mode = dict(
+            dataset.vocabulary_config.measurements_per_generative_mode
+        )
+        for k in DataModality.values():
+            if k not in self.measurements_per_generative_mode:
+                self.measurements_per_generative_mode[k] = []
+
+        if self.structured_event_processing_mode == StructuredEventProcessingMode.NESTED_ATTENTION:
+            in_dep = {
+                x[0] if isinstance(x, (list, tuple)) and len(x) == 2 else x
+                for x in itertools.chain.from_iterable(self.measurements_per_dep_graph_level)
+            }
+            in_generative_mode = set(
+                itertools.chain.from_iterable(self.measurements_per_generative_mode.values())
+            )
+            if not in_generative_mode.issubset(in_dep):
+                raise ValueError(
+                    "Config is attempting to generate something outside the dependency graph:\n"
+                    f"{in_generative_mode - in_dep}"
+                )
+
+        self.event_types_idxmap = dataset.vocabulary_config.event_types_idxmap
+        self.vocab_offsets_by_measurement = dataset.vocabulary_config.vocab_offsets_by_measurement
+        self.vocab_sizes_by_measurement = dict(dataset.vocabulary_config.vocab_sizes_by_measurement)
+        for k in set(self.vocab_offsets_by_measurement.keys()) - set(self.vocab_sizes_by_measurement.keys()):
+            self.vocab_sizes_by_measurement[k] = 1
+
+        self.vocab_size = dataset.vocabulary_config.total_vocab_size
+        self.max_seq_len = dataset.max_seq_len
+
+        if self.TTE_generation_layer_type == TimeToEventGenerationHeadType.LOG_NORMAL_MIXTURE:
+            self.mean_log_inter_event_time_min = dataset.mean_log_inter_event_time_min
+            self.std_log_inter_event_time_min = dataset.std_log_inter_event_time_min
+
+        if getattr(dataset, "has_task", False):
+            if len(dataset.tasks) == 1:
+                self.finetuning_task = dataset.tasks[0]
+                task_type = dataset.task_types[self.finetuning_task]
+                if task_type in ("binary_classification", "multi_class_classification"):
+                    self.id2label = {i: v for i, v in enumerate(dataset.task_vocabs[self.finetuning_task])}
+                    self.label2id = {v: i for i, v in self.id2label.items()}
+                    self.num_labels = len(self.id2label)
+                    self.problem_type = "single_label_classification"
+                elif task_type == "regression":
+                    self.num_labels = 1
+                    self.problem_type = "regression"
+            elif all(t == "binary_classification" for t in dataset.task_types.values()):
+                self.problem_type = "multi_label_classification"
+                self.num_labels = len(dataset.tasks)
+            elif all(t == "regression" for t in dataset.task_types.values()):
+                self.num_labels = len(dataset.tasks)
+                self.problem_type = "regression"
+
+    def to_dict(self) -> dict[str, Any]:
+        """Serializes to a plain dict, recursing into measurement configs."""
+        as_dict = {
+            k: v for k, v in self.__dict__.items() if k not in ("seq_attention_layers", "_extra_kwargs")
+        }
+        as_dict.pop("dep_graph_attention_layers", None)
+        if as_dict.get("measurement_configs"):
+            as_dict["measurement_configs"] = {
+                k: (v if isinstance(v, dict) else v.to_dict())
+                for k, v in as_dict["measurement_configs"].items()
+            }
+        if as_dict.get("id2label") is not None:
+            as_dict["id2label"] = {int(k): v for k, v in as_dict["id2label"].items()}
+        return as_dict
+
+    @classmethod
+    def from_dict(cls, as_dict: dict) -> "StructuredTransformerConfig":
+        as_dict = dict(as_dict)
+        if as_dict.get("id2label") is not None:
+            as_dict["id2label"] = {int(k): v for k, v in as_dict["id2label"].items()}
+        return cls(**as_dict)
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, StructuredTransformerConfig):
+            return False
+        return self.to_dict() == other.to_dict()
+
+
+@config_dataclass
+class OptimizationConfig(JSONableMixin):
+    """Optimization settings: AdamW + polynomial decay with linear warmup.
+
+    Reference: ``transformer/config.py:209-311`` (``OptimizationConfig``).
+    ``set_to_dataset`` derives step counts from dataset length.
+    """
+
+    init_lr: float = 1e-2
+    end_lr: float = 1e-7
+    end_lr_frac_of_init_lr: float | None = None
+    max_epochs: int = 1
+    batch_size: int = 32
+    validation_batch_size: int | None = None
+    lr_frac_warmup_steps: float | None = 0.01
+    lr_num_warmup_steps: int | None = None
+    max_training_steps: int | None = None
+    lr_decay_power: float = 1.0
+    weight_decay: float = 0.01
+    gradient_accumulation: int | None = None
+    num_dataloader_workers: int = 0
+    patience: int | None = None
+
+    def __post_init__(self):
+        if self.end_lr_frac_of_init_lr is not None:
+            if self.end_lr is not None and self.init_lr is not None:
+                expected = self.end_lr_frac_of_init_lr * self.init_lr
+                if abs(expected - self.end_lr) > 1e-12 * max(abs(expected), 1):
+                    raise ValueError("end_lr, end_lr_frac_of_init_lr, and init_lr are inconsistent!")
+            self.end_lr = self.end_lr_frac_of_init_lr * self.init_lr
+        if self.validation_batch_size is None:
+            self.validation_batch_size = self.batch_size
+
+    def set_to_dataset(self, dataset) -> None:
+        """Derives ``max_training_steps`` / warmup steps from dataset length.
+
+        Reference: ``transformer/config.py:277-311``.
+        """
+        steps_per_epoch = int(math.ceil(len(dataset) / self.batch_size))
+        if self.max_training_steps is None:
+            self.max_training_steps = steps_per_epoch * self.max_epochs
+        if self.lr_num_warmup_steps is None:
+            if self.lr_frac_warmup_steps is None:
+                raise ValueError("Must set either lr_frac_warmup_steps or lr_num_warmup_steps")
+            self.lr_num_warmup_steps = int(round(self.lr_frac_warmup_steps * self.max_training_steps))
+        elif self.lr_frac_warmup_steps is None:
+            self.lr_frac_warmup_steps = self.lr_num_warmup_steps / self.max_training_steps

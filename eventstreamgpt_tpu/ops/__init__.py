@@ -1,0 +1,17 @@
+"""TPU-friendly tensor ops: masked reductions, sparse expansion, embedding bags.
+
+These are the jnp equivalents of the reference's torch tensor utilities
+(``/root/reference/EventStream/transformer/utils.py`` and the EmbeddingBag use
+in ``data/data_embedding_layer.py``), re-designed as pure functions so they
+fuse under XLA.
+"""
+
+from .tensor_ops import (  # noqa: F401
+    embedding_bag,
+    expand_indexed_regression,
+    measurement_index_normalization,
+    safe_masked_max,
+    safe_weighted_avg,
+    str_summary,
+    weighted_loss,
+)

@@ -1,0 +1,180 @@
+"""Masked reductions and sparse-feature ops with reference-exact semantics.
+
+Reference contracts: ``/root/reference/EventStream/transformer/utils.py``
+(``safe_masked_max`` ``:61``, ``safe_weighted_avg`` ``:134``, ``weighted_loss``
+``:209``, ``expand_indexed_regression`` ``:33``) and the ``EmbeddingBag(mode=
+"sum", padding_idx=0)`` behavior underlying the data embedding layer
+(``data/data_embedding_layer.py:524-607``). All functions here are pure jnp
+and jit/vmap/grad-safe; none rely on data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def str_summary(T) -> str:
+    """Returns a string summary of an array for debugging purposes.
+
+    Examples:
+        >>> import jax.numpy as jnp
+        >>> T = jnp.asarray([[[1., 2., 3., 4., 5.], [6., 7., 8., 9., 10.]]])
+        >>> str_summary(T)
+        'shape: (1, 2, 5), type: float32, range: 1.0-10.0'
+    """
+    return f"shape: {tuple(T.shape)}, type: {T.dtype}, range: {T.min():n}-{T.max():n}"
+
+
+def expand_indexed_regression(X: jnp.ndarray, idx: jnp.ndarray, vocab_size: int) -> jnp.ndarray:
+    """Expands sparse values ``X`` at indices ``idx`` into a dense last axis.
+
+    Matches ``transformer/utils.py:33``: output shape ``[..., vocab_size]``
+    with ``out[..., idx[..., i]] = X[..., i]`` and zeros elsewhere. Duplicate
+    indices resolve to one of the written values (scatter semantics), as in
+    torch's ``scatter``.
+
+    Examples:
+        >>> import jax.numpy as jnp
+        >>> X = jnp.asarray([[1., 2., 3.], [4., 5., 6.]])
+        >>> idx = jnp.asarray([[0, 1, 2], [1, 3, 0]])
+        >>> expand_indexed_regression(X, idx, 5)
+        Array([[1., 2., 3., 0., 0.],
+               [6., 4., 0., 5., 0.]], dtype=float32)
+    """
+    # One-hot matmul formulation: MXU-friendly and avoids ragged scatters.
+    # Where duplicate indices exist torch.scatter keeps an arbitrary one; a sum
+    # is deterministic, and every caller passes distinct indices per row.
+    one_hot = jnp.asarray(idx[..., None] == jnp.arange(vocab_size), dtype=X.dtype)
+    return jnp.einsum("...mv,...m->...v", one_hot, X)
+
+
+def safe_masked_max(X: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Max over the last axis of ``X`` where ``mask`` is True; 0 for empty rows.
+
+    ``mask`` is either element-wise (same shape as ``X``) or column-wise (same
+    shape as ``X`` minus the second-to-last axis). Reference:
+    ``transformer/utils.py:61``.
+
+    Examples:
+        >>> import jax.numpy as jnp
+        >>> X = jnp.asarray([[1., 2., 3.], [4., 5., 6.]])
+        >>> mask = jnp.asarray([[True, True, False], [False, False, False]])
+        >>> safe_masked_max(X, mask)
+        Array([2., 0.], dtype=float32)
+        >>> X = jnp.asarray([[[1., 2., 3.], [4., 5., 6.]], [[7., 8., 9.], [10., 11., 12.]]])
+        >>> mask = jnp.asarray([[False, True, False], [True, False, True]])
+        >>> safe_masked_max(X, mask)
+        Array([[ 2.,  5.],
+               [ 9., 12.]], dtype=float32)
+    """
+    if mask.ndim < X.ndim:
+        if mask.shape != X.shape[:-2] + X.shape[-1:]:
+            raise AssertionError(
+                f"mask {mask.shape} must be the same shape as X {X.shape} "
+                "or the same shape as X excluding the second to last dimension"
+            )
+        mask = jnp.broadcast_to(mask[..., None, :], X.shape)
+    elif mask.shape != X.shape:
+        raise AssertionError(
+            f"mask {mask.shape} must be the same shape as X {X.shape} "
+            "or the same shape as X excluding the second to last dimension"
+        )
+    maxes = jnp.max(jnp.where(mask, X, -jnp.inf), axis=-1)
+    return jnp.where(jnp.isneginf(maxes), 0.0, maxes)
+
+
+def safe_weighted_avg(X: jnp.ndarray, weights: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Weighted average over the last axis; (0, 0) where weights sum to zero.
+
+    Returns ``(avg, denom)``. ``weights`` is element-wise or column-wise as in
+    `safe_masked_max`. Reference: ``transformer/utils.py:134``.
+
+    Examples:
+        >>> import jax.numpy as jnp
+        >>> X = jnp.asarray([[1., 2., 3.], [4., 5., 6.]])
+        >>> weights = jnp.asarray([[0., 0., 0.], [1., 0., 0.]])
+        >>> safe_weighted_avg(X, weights)
+        (Array([0., 4.], dtype=float32), Array([0., 1.], dtype=float32))
+    """
+    if weights.ndim < X.ndim:
+        if weights.shape != X.shape[:-2] + X.shape[-1:]:
+            raise AssertionError(
+                f"weights {weights.shape} must be the same shape as X {X.shape} "
+                "or the same shape as X excluding the second to last dimension"
+            )
+        weights = jnp.broadcast_to(weights[..., None, :], X.shape)
+    elif weights.shape != X.shape:
+        raise AssertionError(
+            f"weights {weights.shape} must be the same shape as X {X.shape} "
+            "or the same shape as X excluding the second to last dimension"
+        )
+    weights = weights.astype(jnp.float32)
+    denom = weights.sum(axis=-1)
+    safe_denom = jnp.where(denom > 0, denom, 1.0)
+    avg = jnp.where(denom > 0, (X * weights).sum(axis=-1) / safe_denom, 0.0)
+    return avg, denom
+
+
+def weighted_loss(loss_per_event: jnp.ndarray, event_mask: jnp.ndarray) -> jnp.ndarray:
+    """Macro-average: per-event → per-subject mean → mean over non-empty subjects.
+
+    Reference: ``transformer/utils.py:209``. This nested-macro-average contract
+    is the loss-parity-critical reduction used by every generative head.
+
+    Examples:
+        >>> import jax.numpy as jnp
+        >>> loss_per_event = jnp.asarray([[1., 2., 3.], [4., 5., 6.]])
+        >>> event_mask = jnp.asarray([[1., 1., 1.], [1., 0., 0.]])
+        >>> weighted_loss(loss_per_event, event_mask)
+        Array(3., dtype=float32)
+    """
+    loss_per_subject, events_per_subject = safe_weighted_avg(loss_per_event, event_mask)
+    return safe_weighted_avg(loss_per_subject, (events_per_subject > 0))[0]
+
+
+def embedding_bag(
+    table: jnp.ndarray,
+    indices: jnp.ndarray,
+    weights: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Sum-mode embedding bag with padding index 0, as ``take`` + weighted sum.
+
+    Equivalent to ``torch.nn.EmbeddingBag(mode="sum", padding_idx=0)`` with
+    ``per_sample_weights``: rows with index 0 contribute nothing regardless of
+    weight (reference behavior relied on at ``data_embedding_layer.py:524``).
+
+    Args:
+        table: ``(n_embeddings, dim)`` embedding table.
+        indices: int array ``(..., M)``.
+        weights: optional float array ``(..., M)`` of per-sample weights.
+
+    Returns:
+        ``(..., dim)`` summed embeddings.
+    """
+    gathered = jnp.take(table, indices, axis=0)  # (..., M, dim)
+    pad_mask = (indices != 0).astype(gathered.dtype)
+    w = pad_mask if weights is None else weights.astype(gathered.dtype) * pad_mask
+    return jnp.einsum("...md,...m->...d", gathered, w)
+
+
+def measurement_index_normalization(measurement_indices: jnp.ndarray) -> jnp.ndarray:
+    """Per-row weights giving each unique measurement equal total mass.
+
+    Reference: ``data_embedding_layer.py:316-349``. Index 0 is padding and gets
+    zero weight; rows with no observations return all zeros.
+
+    Examples:
+        >>> import jax.numpy as jnp
+        >>> mi = jnp.asarray([[1, 2, 5, 2, 2], [1, 3, 5, 3, 0]])
+        >>> measurement_index_normalization(mi).round(4)
+        Array([[0.3333, 0.1111, 0.3333, 0.1111, 0.1111],
+               [0.3333, 0.1667, 0.3333, 0.1667, 0.    ]], dtype=float32)
+    """
+    # Pairwise-equality formulation needs no static vocab bound:
+    # counts[i, j] = #{k : mi[i, k] == mi[i, j]}.
+    eq = measurement_indices[..., :, None] == measurement_indices[..., None, :]
+    counts = eq.sum(axis=-1)  # (..., M)
+    vals = jnp.where(measurement_indices == 0, 0.0, 1.0 / counts)
+    denom = vals.sum(axis=-1, keepdims=True)
+    denom = jnp.where(denom == 0, 1.0, denom)
+    return vals / denom

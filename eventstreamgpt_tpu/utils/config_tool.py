@@ -84,10 +84,7 @@ def _coerce(value: Any, tp: Any) -> Any:
         if tp is bool and isinstance(value, str):
             return value.lower() in ("true", "1", "yes")
         if tp in (int, float, str) and not isinstance(value, (dict, list)):
-            try:
-                return tp(value)
-            except (TypeError, ValueError):
-                return value
+            return tp(value)
     if origin in (list, tuple) and isinstance(value, (list, tuple)):
         args = typing.get_args(tp)
         if args:
@@ -143,6 +140,17 @@ def _interpolate_str(s: str, root: dict[str, Any]) -> Any:
     def lookup(expr: str) -> Any:
         if expr.startswith("now:"):
             return datetime.datetime.now().strftime(expr[4:])
+        if expr.startswith("oc.env:"):
+            import os
+
+            spec = expr[len("oc.env:") :]
+            var, _, default = spec.partition(",")
+            val = os.environ.get(var)
+            if val is not None:
+                return val
+            if _:
+                return default
+            raise KeyError(f"Environment variable '{var}' (from ${{{expr}}}) is not set")
         node: Any = root
         for part in expr.split("."):
             if isinstance(node, dict) and part in node:
@@ -259,6 +267,7 @@ def load_config(
         with open(yaml_file) as f:
             loaded = yaml.safe_load(f) or {}
         loaded.pop("defaults", None)  # hydra defaults-list: handled by caller
+        loaded.pop("hydra", None)  # hydra runtime block: not config values
         merge(merged, loaded)
     if overrides:
         if isinstance(overrides, list):
@@ -271,6 +280,5 @@ def load_config(
 
 def main_entry(config_cls: type[T], fn: Callable[[T], Any], yaml_file: Path | str | None = None) -> Any:
     """CLI driver: parse ``sys.argv[1:]`` as overrides and invoke ``fn(cfg)``."""
-    argv = [a for a in sys.argv[1:] if "=" in a]
-    cfg = load_config(config_cls, yaml_file=yaml_file, overrides=argv)
+    cfg = load_config(config_cls, yaml_file=yaml_file, overrides=sys.argv[1:])
     return fn(cfg)

@@ -1,0 +1,214 @@
+"""Parity tests for tensor ops and distributions against torch oracles.
+
+The reference implementation delegates these semantics to
+``torch``/``torch.distributions``/EmbeddingBag; testing against torch on CPU
+pins the rebuild to the exact same numerics (SURVEY.md §4, §7 "hard parts").
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from eventstreamgpt_tpu.distributions import (
+    Bernoulli,
+    Categorical,
+    Exponential,
+    LogNormalMixture,
+    Normal,
+)
+from eventstreamgpt_tpu.ops import (
+    embedding_bag,
+    expand_indexed_regression,
+    measurement_index_normalization,
+    safe_masked_max,
+    safe_weighted_avg,
+    weighted_loss,
+)
+
+RNG = np.random.default_rng(0)
+
+
+def assert_close(jax_val, torch_val, rtol=1e-3, atol=1e-4):
+    np.testing.assert_allclose(np.asarray(jax_val), torch_val.detach().numpy(), rtol=rtol, atol=atol)
+
+
+class TestTensorOps:
+    def test_expand_indexed_regression(self):
+        X = jnp.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        idx = jnp.asarray([[0, 1, 2], [1, 3, 0]])
+        out = expand_indexed_regression(X, idx, 5)
+        expected = torch.zeros(2, 5).scatter(
+            -1, torch.tensor([[0, 1, 2], [1, 3, 0]]), torch.tensor([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        )
+        assert_close(out, expected)
+
+    def test_safe_masked_max_elementwise(self):
+        X = jnp.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        mask = jnp.asarray([[True, True, False], [False, False, False]])
+        np.testing.assert_allclose(np.asarray(safe_masked_max(X, mask)), [2.0, 0.0])
+
+    def test_safe_masked_max_columnwise(self):
+        X = jnp.asarray([[[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], [[7.0, 8.0, 9.0], [10.0, 11.0, 12.0]]])
+        mask = jnp.asarray([[False, True, False], [True, False, True]])
+        np.testing.assert_allclose(np.asarray(safe_masked_max(X, mask)), [[2.0, 5.0], [9.0, 12.0]])
+
+    def test_safe_masked_max_bad_shape(self):
+        X = jnp.ones((2, 2, 3))
+        with pytest.raises(AssertionError):
+            safe_masked_max(X, jnp.ones((2, 2), dtype=bool))
+
+    def test_safe_weighted_avg(self):
+        X = jnp.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        w = jnp.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        avg, denom = safe_weighted_avg(X, w)
+        np.testing.assert_allclose(np.asarray(avg), [14 / 6, 77 / 15], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(denom), [6.0, 15.0])
+        avg0, denom0 = safe_weighted_avg(X, jnp.asarray([[0.0, 0.0, 0.0], [1.0, 0.0, 0.0]]))
+        np.testing.assert_allclose(np.asarray(avg0), [0.0, 4.0])
+
+    def test_weighted_loss(self):
+        lpe = jnp.asarray([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+        em = jnp.asarray([[1.0, 1.0, 1.0], [1.0, 0.0, 0.0]])
+        np.testing.assert_allclose(np.asarray(weighted_loss(lpe, em)), 3.0)
+
+    def test_embedding_bag_matches_torch(self):
+        n_emb, dim = 20, 8
+        table = RNG.normal(size=(n_emb, dim)).astype(np.float32)
+        indices = RNG.integers(0, n_emb, size=(6, 5))
+        indices[0, :2] = 0
+        weights = RNG.normal(size=(6, 5)).astype(np.float32)
+
+        t_bag = torch.nn.EmbeddingBag(n_emb, dim, mode="sum", padding_idx=0)
+        with torch.no_grad():
+            t_bag.weight.copy_(torch.from_numpy(table))
+            t_bag.weight[0] = 0.0
+        expected = t_bag(torch.from_numpy(indices), per_sample_weights=torch.from_numpy(weights))
+
+        out = embedding_bag(jnp.asarray(table), jnp.asarray(indices), jnp.asarray(weights))
+        assert_close(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_embedding_bag_no_weights(self):
+        table = jnp.asarray(RNG.normal(size=(10, 4)).astype(np.float32))
+        indices = jnp.asarray([[1, 2, 0], [0, 0, 0]])
+        out = embedding_bag(table, indices)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(table[1] + table[2]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out[1]), 0.0)
+
+    def test_measurement_index_normalization(self):
+        mi = jnp.asarray([[1, 2, 5, 2, 2], [1, 3, 5, 3, 0]])
+        out = measurement_index_normalization(mi)
+        expected = [[1 / 3, 1 / 9, 1 / 3, 1 / 9, 1 / 9], [1 / 3, 1 / 6, 1 / 3, 1 / 6, 0.0]]
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+class TestDistributions:
+    def test_categorical_log_prob(self):
+        logits = RNG.normal(size=(4, 7)).astype(np.float32)
+        values = RNG.integers(0, 7, size=(4,))
+        ours = Categorical(logits=jnp.asarray(logits)).log_prob(jnp.asarray(values))
+        theirs = torch.distributions.Categorical(logits=torch.from_numpy(logits)).log_prob(
+            torch.from_numpy(values)
+        )
+        assert_close(ours, theirs, rtol=1e-4, atol=1e-4)
+
+    def test_bernoulli_log_prob(self):
+        logits = RNG.normal(size=(4, 7)).astype(np.float32)
+        values = RNG.integers(0, 2, size=(4, 7)).astype(np.float32)
+        ours = Bernoulli(logits=jnp.asarray(logits)).log_prob(jnp.asarray(values))
+        theirs = torch.distributions.Bernoulli(logits=torch.from_numpy(logits)).log_prob(
+            torch.from_numpy(values)
+        )
+        assert_close(ours, theirs)
+
+    def test_normal_log_prob(self):
+        loc = RNG.normal(size=(5,)).astype(np.float32)
+        scale = RNG.uniform(0.5, 2.0, size=(5,)).astype(np.float32)
+        values = RNG.normal(size=(5,)).astype(np.float32)
+        ours = Normal(loc=jnp.asarray(loc), scale=jnp.asarray(scale)).log_prob(jnp.asarray(values))
+        theirs = torch.distributions.Normal(torch.from_numpy(loc), torch.from_numpy(scale)).log_prob(
+            torch.from_numpy(values)
+        )
+        assert_close(ours, theirs)
+
+    def test_exponential_log_prob(self):
+        rate = RNG.uniform(0.5, 3.0, size=(6,)).astype(np.float32)
+        values = RNG.uniform(0.1, 5.0, size=(6,)).astype(np.float32)
+        ours = Exponential(rate=jnp.asarray(rate)).log_prob(jnp.asarray(values))
+        theirs = torch.distributions.Exponential(torch.from_numpy(rate)).log_prob(torch.from_numpy(values))
+        assert_close(ours, theirs)
+
+    def test_lognormal_mixture_log_prob_vs_torch_composition(self):
+        """Checks against the torch composition pytorch_lognormal_mixture uses:
+        TransformedDistribution(MixtureSameFamily(Cat, Normal), [Affine, Exp])."""
+        K = 3
+        locs = RNG.normal(size=(4, K)).astype(np.float32)
+        log_scales = RNG.normal(size=(4, K)).astype(np.float32) * 0.3
+        log_weights = RNG.normal(size=(4, K)).astype(np.float32)
+        mean_log, std_log = 0.7, 1.3
+        t = RNG.uniform(0.1, 10.0, size=(4,)).astype(np.float32)
+
+        ours = LogNormalMixture(
+            locs=jnp.asarray(locs),
+            log_scales=jnp.asarray(log_scales),
+            log_weights=jnp.asarray(log_weights),
+            mean_log_inter_time=mean_log,
+            std_log_inter_time=std_log,
+        ).log_prob(jnp.asarray(t))
+
+        gmm = torch.distributions.MixtureSameFamily(
+            torch.distributions.Categorical(logits=torch.from_numpy(log_weights)),
+            torch.distributions.Normal(
+                torch.from_numpy(locs), torch.from_numpy(np.exp(log_scales))
+            ),
+        )
+        theirs = torch.distributions.TransformedDistribution(
+            gmm,
+            [
+                torch.distributions.transforms.AffineTransform(loc=mean_log, scale=std_log),
+                torch.distributions.transforms.ExpTransform(),
+            ],
+        ).log_prob(torch.from_numpy(t))
+        assert_close(ours, theirs, rtol=1e-4, atol=1e-5)
+
+    def test_sampling_shapes_and_ranges(self):
+        key = jax.random.PRNGKey(0)
+        cat = Categorical(logits=jnp.zeros((3, 5)))
+        s = cat.sample(key)
+        assert s.shape == (3,) and (np.asarray(s) < 5).all()
+
+        exp = Exponential(rate=jnp.ones((3,)))
+        s = exp.sample(key)
+        assert s.shape == (3,) and (np.asarray(s) > 0).all()
+
+        lnm = LogNormalMixture(
+            locs=jnp.zeros((3, 2)), log_scales=jnp.zeros((3, 2)), log_weights=jnp.zeros((3, 2))
+        )
+        s = lnm.sample(key, (7,))
+        assert s.shape == (7, 3) and (np.asarray(s) > 0).all()
+
+    def test_lognormal_mixture_sample_statistics(self):
+        key = jax.random.PRNGKey(1)
+        lnm = LogNormalMixture(
+            locs=jnp.asarray([[0.0, 1.0]]),
+            log_scales=jnp.asarray([[-1.0, -1.0]]),
+            log_weights=jnp.asarray([[0.0, 0.0]]),
+        )
+        samples = lnm.sample(key, (20000,))
+        np.testing.assert_allclose(np.asarray(samples.mean()), np.asarray(lnm.mean)[0], rtol=0.05)
+
+    def test_distribution_slicing(self):
+        """Slicing a distribution pytree replaces the reference's idx_distribution."""
+        cat = Categorical(logits=jnp.asarray(RNG.normal(size=(4, 6, 5)).astype(np.float32)))
+        sliced = cat[:, -1]
+        assert sliced.logits.shape == (4, 5)
+        np.testing.assert_allclose(np.asarray(sliced.logits), np.asarray(cat.logits[:, -1]))
+
+        lnm = LogNormalMixture(
+            locs=jnp.zeros((4, 6, 3)), log_scales=jnp.zeros((4, 6, 3)), log_weights=jnp.zeros((4, 6, 3)),
+            mean_log_inter_time=0.5, std_log_inter_time=2.0,
+        )
+        sliced = lnm[:, 2:3]
+        assert sliced.locs.shape == (4, 1, 3)
+        assert sliced.std_log_inter_time == 2.0
