@@ -1,0 +1,111 @@
+"""Benchmark: pretraining throughput (events/sec/chip) on the flagship config.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}. The
+baseline is the driver's north star of 5,000 events/sec/chip on the MIMIC-IV
+tutorial-scale CI pretrain config (BASELINE.json); vs_baseline = value / 5000.
+
+Runs on whatever device JAX selects (the real TPU chip under the driver;
+CPU elsewhere). Uses a synthetic batch shaped like the MIMIC-IV tutorial
+config: batch 32, seq 256, 16 data elements/event, vocab ~4k, hidden 256.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from eventstreamgpt_tpu.data.types import EventStreamBatch
+    from eventstreamgpt_tpu.models.ci_model import CIPPTForGenerativeSequenceModeling
+    from eventstreamgpt_tpu.models.config import StructuredTransformerConfig
+
+    B, L, M = 32, 256, 16
+    VOCAB = 4096
+    HIDDEN = 256
+
+    config = StructuredTransformerConfig(
+        vocab_sizes_by_measurement={"event_type": 40, "labs": VOCAB - 41},
+        vocab_offsets_by_measurement={"event_type": 1, "labs": 41},
+        measurements_idxmap={"event_type": 1, "labs": 2},
+        measurements_per_generative_mode={
+            "single_label_classification": ["event_type"],
+            "multi_label_classification": ["labs"],
+            "multivariate_regression": ["labs"],
+        },
+        max_seq_len=L,
+        hidden_size=HIDDEN,
+        head_dim=HIDDEN // 4,
+        num_attention_heads=4,
+        num_hidden_layers=2,
+        seq_attention_types=["local", "global"],
+        seq_window_size=32,
+        intermediate_size=HIDDEN * 4,
+        TTE_generation_layer_type="log_normal_mixture",
+        TTE_lognormal_generation_num_components=3,
+    )
+
+    rng = np.random.default_rng(0)
+    # One single-label event_type element per event; the rest are labs.
+    dyn_meas = np.full((B, L, M), 2, dtype=np.int64)
+    dyn_meas[:, :, 0] = 1
+    dyn_idx = np.where(
+        dyn_meas == 1,
+        rng.integers(1, 41, size=dyn_meas.shape),
+        rng.integers(41, VOCAB, size=dyn_meas.shape),
+    )
+    batch = EventStreamBatch(
+        event_mask=jnp.ones((B, L), dtype=bool),
+        time_delta=jnp.asarray(rng.uniform(0.5, 60.0, size=(B, L)).astype(np.float32)),
+        static_indices=jnp.asarray(rng.integers(1, VOCAB, size=(B, 4))),
+        static_measurement_indices=jnp.asarray(np.ones((B, 4), dtype=np.int64)),
+        dynamic_indices=jnp.asarray(dyn_idx),
+        dynamic_measurement_indices=jnp.asarray(dyn_meas),
+        dynamic_values=jnp.asarray(rng.normal(size=dyn_meas.shape).astype(np.float32)),
+        dynamic_values_mask=jnp.asarray((dyn_meas == 2) & (rng.random(dyn_meas.shape) < 0.5)),
+    )
+
+    model = CIPPTForGenerativeSequenceModeling(config)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        def loss_fn(p):
+            return model.apply(p, batch).loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # Warmup/compile.
+    params, opt_state, loss = train_step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = train_step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    events_per_sec = (B * L * n_steps) / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "pretrain_events_per_sec_per_chip",
+                "value": round(events_per_sec, 1),
+                "unit": "events/sec/chip",
+                "vs_baseline": round(events_per_sec / 5000.0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

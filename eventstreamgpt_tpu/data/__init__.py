@@ -8,6 +8,7 @@ from .config import (
     SubsequenceSamplingStrategy,
     VocabularyConfig,
 )
+from .jax_dataset import JaxDataset
 from .time_dependent_functor import AgeFunctor, TimeDependentFunctor, TimeOfDayFunctor
 from .types import (
     DataModality,
@@ -29,6 +30,7 @@ __all__ = [
     "InputDataType",
     "InputDFSchema",
     "InputDFType",
+    "JaxDataset",
     "MeasurementConfig",
     "NumericDataModalitySubtype",
     "PytorchDatasetConfig",

@@ -59,7 +59,9 @@ class Categorical(_Indexable):
 
     def log_prob(self, value: Array) -> Array:
         value = value.astype(jnp.int32)
-        return jnp.take_along_axis(self.log_probs, value[..., None], axis=-1)[..., 0]
+        # mode="clip" keeps CPU and TPU behavior identical on out-of-range
+        # labels (TPU hardware gathers clamp; CPU would return NaN).
+        return jnp.take_along_axis(self.log_probs, value[..., None], axis=-1, mode="clip")[..., 0]
 
     def sample(self, key: jax.Array, sample_shape: tuple[int, ...] = ()) -> Array:
         shape = sample_shape + self.logits.shape[:-1]
@@ -162,8 +164,9 @@ class LogNormalMixture(_Indexable):
     picks up the Jacobian ``1/(t * std_log_inter_time)``.
 
     Parameters ``locs``, ``log_scales``, ``log_weights`` all have shape
-    ``(..., K)``; ``mean_log_inter_time``/``std_log_inter_time`` are scalars
-    (pytree leaves so they survive tree_map slicing).
+    ``(..., K)``; ``mean_log_inter_time``/``std_log_inter_time`` are static
+    python floats (treedef aux data, NOT pytree leaves — so tree_map slicing
+    leaves them untouched; do not pass jax arrays for them).
     """
 
     locs: Array

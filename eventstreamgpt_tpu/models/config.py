@@ -507,29 +507,36 @@ class OptimizationConfig(JSONableMixin):
     """
 
     init_lr: float = 1e-2
-    end_lr: float = 1e-7
-    end_lr_frac_of_init_lr: float | None = None
-    max_epochs: int = 1
+    end_lr: float | None = None
+    end_lr_frac_of_init_lr: float | None = 1e-3
+    max_epochs: int = 100
     batch_size: int = 32
-    validation_batch_size: int | None = None
+    validation_batch_size: int = 32
     lr_frac_warmup_steps: float | None = 0.01
     lr_num_warmup_steps: int | None = None
     max_training_steps: int | None = None
     lr_decay_power: float = 1.0
     weight_decay: float = 0.01
+    patience: int | None = None
     gradient_accumulation: int | None = None
     num_dataloader_workers: int = 0
-    patience: int | None = None
 
     def __post_init__(self):
         if self.end_lr_frac_of_init_lr is not None:
-            if self.end_lr is not None and self.init_lr is not None:
-                expected = self.end_lr_frac_of_init_lr * self.init_lr
-                if abs(expected - self.end_lr) > 1e-12 * max(abs(expected), 1):
-                    raise ValueError("end_lr, end_lr_frac_of_init_lr, and init_lr are inconsistent!")
+            if self.end_lr_frac_of_init_lr <= 0.0 or self.end_lr_frac_of_init_lr >= 1.0:
+                raise ValueError("`end_lr_frac_of_init_lr` must be between 0.0 and 1.0!")
+            if self.end_lr is not None:
+                prod = self.end_lr_frac_of_init_lr * self.init_lr
+                if not math.isclose(self.end_lr, prod):
+                    raise ValueError(
+                        "If both set, `end_lr` must be equal to `end_lr_frac_of_init_lr * init_lr`! Got "
+                        f"end_lr={self.end_lr}, end_lr_frac_of_init_lr * init_lr = {prod}!"
+                    )
             self.end_lr = self.end_lr_frac_of_init_lr * self.init_lr
-        if self.validation_batch_size is None:
-            self.validation_batch_size = self.batch_size
+        else:
+            if self.end_lr is None:
+                raise ValueError("Must set either end_lr or end_lr_frac_of_init_lr!")
+            self.end_lr_frac_of_init_lr = self.end_lr / self.init_lr
 
     def set_to_dataset(self, dataset) -> None:
         """Derives ``max_training_steps`` / warmup steps from dataset length.
@@ -540,8 +547,17 @@ class OptimizationConfig(JSONableMixin):
         if self.max_training_steps is None:
             self.max_training_steps = steps_per_epoch * self.max_epochs
         if self.lr_num_warmup_steps is None:
-            if self.lr_frac_warmup_steps is None:
-                raise ValueError("Must set either lr_frac_warmup_steps or lr_num_warmup_steps")
+            assert self.lr_frac_warmup_steps is not None
             self.lr_num_warmup_steps = int(round(self.lr_frac_warmup_steps * self.max_training_steps))
         elif self.lr_frac_warmup_steps is None:
             self.lr_frac_warmup_steps = self.lr_num_warmup_steps / self.max_training_steps
+        if not (
+            math.floor(self.lr_frac_warmup_steps * self.max_training_steps) <= self.lr_num_warmup_steps
+        ) and (math.ceil(self.lr_frac_warmup_steps * self.max_training_steps) >= self.lr_num_warmup_steps):
+            raise ValueError(
+                "`self.lr_frac_warmup_steps`, `self.max_training_steps`, and `self.lr_num_warmup_steps` "
+                "should be consistent, but they aren't! Got\n"
+                f"\tself.max_training_steps = {self.max_training_steps}\n"
+                f"\tself.lr_frac_warmup_steps = {self.lr_frac_warmup_steps}\n"
+                f"\tself.lr_num_warmup_steps = {self.lr_num_warmup_steps}"
+            )

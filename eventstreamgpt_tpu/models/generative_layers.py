@@ -1,0 +1,81 @@
+"""Distribution-producing emission heads (TTE + regression).
+
+Rebuild of ``/root/reference/EventStream/transformer/generative_layers.py``
+on JAX distributions. Parameter-extraction conventions (strided slicing of the
+projection output: ``0::3``/``1::3``/``2::3`` for the lognormal mixture,
+``0::2``/``1::2`` for Gaussian heads, ELU+1+tiny positivity) are preserved
+exactly — NLL parity depends on them.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..distributions import Exponential, LogNormalMixture, Normal
+
+
+def _elu_plus_one(x: jnp.ndarray) -> jnp.ndarray:
+    """ELU(x) + 1 + tiny: strictly positive, matching the reference's rate/std
+    transforms (``generative_layers.py:89,140``)."""
+    return jax.nn.elu(x) + 1.0 + jnp.finfo(x.dtype).tiny
+
+
+class LogNormalMixtureTTELayer(nn.Module):
+    """Lognormal-mixture time-to-event head (``generative_layers.py:6``)."""
+
+    num_components: int
+    mean_log_inter_time: float = 0.0
+    std_log_inter_time: float = 1.0
+
+    @nn.compact
+    def __call__(self, T: jnp.ndarray) -> LogNormalMixture:
+        params = nn.Dense(3 * self.num_components, name="proj")(T)
+        return LogNormalMixture(
+            locs=params[..., 0::3],
+            log_scales=params[..., 1::3],
+            log_weights=params[..., 2::3],
+            mean_log_inter_time=self.mean_log_inter_time,
+            std_log_inter_time=self.std_log_inter_time,
+        )
+
+
+class ExponentialTTELayer(nn.Module):
+    """Exponential time-to-event head (``generative_layers.py:62``)."""
+
+    @nn.compact
+    def __call__(self, T: jnp.ndarray) -> Exponential:
+        rate = _elu_plus_one(nn.Dense(1, name="proj")(T))
+        return Exponential(rate=rate[..., 0])
+
+
+class GaussianIndexedRegressionLayer(nn.Module):
+    """Indexed probabilistic regression head (``generative_layers.py:98``).
+
+    Projects to ``2 * n_regression_targets`` (interleaved mean/std) and, when
+    ``idx`` is given, gathers the per-target parameters at the observed
+    indices.
+    """
+
+    n_regression_targets: int
+
+    @nn.compact
+    def __call__(self, X: jnp.ndarray, idx: jnp.ndarray | None = None) -> Normal:
+        Z = nn.Dense(self.n_regression_targets * 2, name="proj")(X)
+        Z_mean = Z[..., 0::2]
+        Z_std = _elu_plus_one(Z[..., 1::2])
+        if idx is None:
+            return Normal(loc=Z_mean, scale=Z_std)
+        mean = jnp.take_along_axis(Z_mean, idx, axis=-1)
+        std = jnp.take_along_axis(Z_std, idx, axis=-1)
+        return Normal(loc=mean, scale=std)
+
+
+class GaussianRegressionLayer(nn.Module):
+    """Univariate probabilistic regression head (``generative_layers.py:149``)."""
+
+    @nn.compact
+    def __call__(self, X: jnp.ndarray) -> Normal:
+        Z = nn.Dense(2, name="proj")(X)
+        return Normal(loc=Z[..., 0::2], scale=_elu_plus_one(Z[..., 1::2]))

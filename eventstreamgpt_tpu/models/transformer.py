@@ -1,0 +1,468 @@
+"""Point-process transformer encoders, TPU-native.
+
+Re-design of ``/root/reference/EventStream/transformer/transformer.py`` for
+XLA: GPT-Neo-style blocks (pre-LN attention with **unscaled** QK^T logits and
+fp32 softmax, exactly as the reference's ``InnerSelfAttention._attn``
+``transformer.py:171-217``), continuous-time sinusoidal position encodings over
+cumulative minutes (``transformer.py:539-620``), and global or local
+(sliding-window) causal masking built from position indices instead of a dense
+``(max_seq_len, max_seq_len)`` tril buffer (``transformer.py:109-118``) so
+memory stays O(L) outside the attention computation itself.
+
+The KV cache diverges deliberately: the reference grows caches by tensor
+concatenation per step (``transformer.py:261-270``), which cannot compile
+under ``jit``. Here a cache is a fixed-size `KVCache` pytree — preallocated
+``(B, H, max_len, D)`` buffers plus a write cursor — updated with
+``lax.dynamic_update_slice`` so the whole generation loop stays on device
+inside ``lax.scan``/``while_loop``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..data.types import EventStreamBatch
+from .config import StructuredTransformerConfig
+from .embedding import DataEmbeddingLayer
+
+Array = Any
+
+ACT2FN = {
+    "gelu": nn.gelu,
+    "gelu_new": nn.gelu,
+    "relu": nn.relu,
+    "silu": nn.silu,
+    "swish": nn.silu,
+    "tanh": jnp.tanh,
+}
+
+MASK_VALUE = -1e9
+
+
+@struct.dataclass
+class KVCache:
+    """A fixed-size per-layer key/value cache with a write cursor.
+
+    ``key``/``value`` have shape ``(B, H, max_len, head_dim)``; ``mask`` is the
+    accumulated key-padding mask ``(B, max_len)`` (True = real event) so that
+    cached decoding preserves each past position's event-mask bit; ``length``
+    is the number of positions already written (scalar int32).
+    """
+
+    key: Array
+    value: Array
+    mask: Array
+    length: Array  # scalar int32
+
+    @classmethod
+    def init(cls, batch_size: int, num_heads: int, max_len: int, head_dim: int, dtype=jnp.float32):
+        return cls(
+            key=jnp.zeros((batch_size, num_heads, max_len, head_dim), dtype=dtype),
+            value=jnp.zeros((batch_size, num_heads, max_len, head_dim), dtype=dtype),
+            mask=jnp.zeros((batch_size, max_len), dtype=bool),
+            length=jnp.zeros((), dtype=jnp.int32),
+        )
+
+
+def init_kv_caches(
+    config: StructuredTransformerConfig, batch_size: int, max_len: int | None = None, dtype=jnp.float32
+) -> tuple[KVCache, ...]:
+    """Preallocates one `KVCache` per hidden layer."""
+    if max_len is None:
+        max_len = config.max_seq_len
+    return tuple(
+        KVCache.init(batch_size, config.num_attention_heads, max_len, config.head_dim, dtype)
+        for _ in range(config.num_hidden_layers)
+    )
+
+
+@struct.dataclass
+class TransformerOutputWithPast:
+    """Encoder output (reference: ``model_output.py:208``)."""
+
+    last_hidden_state: Array
+    past_key_values: Optional[tuple] = None
+    hidden_states: Optional[tuple] = None
+    attentions: Optional[tuple] = None
+
+
+def time_from_deltas(batch: EventStreamBatch) -> Array:
+    """Cumulative time-since-start from per-event deltas.
+
+    Reference: ``transformer.py:539-561``.
+
+    Examples:
+        >>> import jax.numpy as jnp
+        >>> from eventstreamgpt_tpu.data.types import EventStreamBatch
+        >>> batch = EventStreamBatch(
+        ...     event_mask=jnp.asarray([[True, True, True], [True, True, False]]),
+        ...     time_delta=jnp.asarray([[1.0, 3.2, 0.0], [1.4, 0.0, 1.0]]),
+        ... )
+        >>> time_from_deltas(batch)
+        Array([[0. , 1. , 4.2],
+               [0. , 1.4, 1.4]], dtype=float32)
+    """
+    t_deltas = batch.time_delta
+    if batch.event_mask is not None:
+        t_deltas = jnp.where(batch.event_mask, t_deltas, 0.0)
+    csum = jnp.cumsum(t_deltas, axis=-1)
+    return jnp.concatenate([jnp.zeros_like(csum[:, :1]), csum[:, :-1]], axis=1)
+
+
+class TemporalPositionEncoding(nn.Module):
+    """Sinusoidal position encoding over continuous time values (minutes).
+
+    Reference: ``transformer.py:564-620``. Supports odd embedding dims by
+    truncating the cos half.
+    """
+
+    embedding_dim: int
+    max_timepoint: float = 10000.0
+
+    @nn.compact
+    def __call__(self, t: Array) -> Array:
+        div_term = jnp.exp(
+            jnp.arange(0, self.embedding_dim, 2) * (-math.log(self.max_timepoint) / self.embedding_dim)
+        )
+        sin_div = div_term
+        cos_div = div_term if self.embedding_dim % 2 == 0 else div_term[:-1]
+
+        t = t[..., None]
+        sin_emb = jnp.sin(t * sin_div)
+        cos_emb = jnp.cos(t * cos_div)
+        # Interleave: out[..., 0::2] = sin, out[..., 1::2] = cos.
+        out = jnp.zeros(t.shape[:-1] + (self.embedding_dim,), dtype=sin_emb.dtype)
+        out = out.at[..., 0::2].set(sin_emb)
+        out = out.at[..., 1::2].set(cos_emb)
+        return out
+
+
+def make_causal_mask(
+    q_positions: Array, k_positions: Array, window_size: int | None = None
+) -> Array:
+    """Boolean (…, Q, K) mask: True where query may attend to key.
+
+    Global: ``k <= q``. Local: additionally ``k > q - window_size`` — the
+    sliding-window rule the reference encodes in its XOR'd tril buffer
+    (``transformer.py:109-118``).
+    """
+    q = q_positions[..., :, None]
+    k = k_positions[..., None, :]
+    mask = k <= q
+    if window_size is not None:
+        mask = mask & (k > q - window_size)
+    return mask
+
+
+class InnerSelfAttention(nn.Module):
+    """Multi-head causal self-attention with optional local windowing.
+
+    Numerics match the reference (``transformer.py:171-217``): no ``1/sqrt(d)``
+    scaling of logits, softmax in fp32, additive padding mask. Supports an
+    optional fixed-size `KVCache` and the ``static_kv_first`` trick where the
+    first position is key/value-only (``transformer.py:256-259``).
+    """
+
+    config: StructuredTransformerConfig
+    attention_type: str = "global"
+    window_size: int | None = None
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states: Array,
+        attention_mask: Array | None = None,  # (B, K) boolean: True = attend
+        layer_past: KVCache | None = None,
+        use_cache: bool = False,
+        output_attentions: bool = False,
+        static_kv_first: bool = False,
+    ):
+        cfg = self.config
+        embed_dim = cfg.hidden_size
+        num_heads = cfg.num_attention_heads
+        head_dim = cfg.head_dim
+        if head_dim * num_heads != embed_dim:
+            raise ValueError(
+                f"embed_dim must be divisible by num_heads (got `embed_dim`: {embed_dim} and "
+                f"`num_heads`: {num_heads})."
+            )
+        dense_init = nn.initializers.normal(stddev=cfg.init_std)
+        q_proj = nn.Dense(embed_dim, use_bias=False, kernel_init=dense_init, name="q_proj")
+        k_proj = nn.Dense(embed_dim, use_bias=False, kernel_init=dense_init, name="k_proj")
+        v_proj = nn.Dense(embed_dim, use_bias=False, kernel_init=dense_init, name="v_proj")
+        out_proj = nn.Dense(embed_dim, use_bias=True, kernel_init=dense_init, name="out_proj")
+
+        B, S = hidden_states.shape[0], hidden_states.shape[1]
+
+        def split_heads(x):
+            return x.reshape(x.shape[:-1] + (num_heads, head_dim)).swapaxes(-3, -2)
+
+        query = split_heads(q_proj(hidden_states))  # (B, H, S, D)
+        key = split_heads(k_proj(hidden_states))
+        value = split_heads(v_proj(hidden_states))
+
+        if static_kv_first:
+            query = query[:, :, 1:, :]
+
+        q_len = query.shape[2]
+
+        present = None
+        if layer_past is not None:
+            # Fixed-buffer cache: write new keys/values (and the chunk's
+            # padding-mask bits) at the cursor, then attend over the full
+            # buffer with validity masking.
+            max_len = layer_past.key.shape[2]
+            start = layer_past.length
+            new_key = jax.lax.dynamic_update_slice(layer_past.key, key, (0, 0, start, 0))
+            new_value = jax.lax.dynamic_update_slice(layer_past.value, value, (0, 0, start, 0))
+            chunk_mask = (
+                attention_mask if attention_mask is not None else jnp.ones((B, S), dtype=bool)
+            )
+            new_mask = jax.lax.dynamic_update_slice(layer_past.mask, chunk_mask, (0, start))
+            if use_cache:
+                present = KVCache(key=new_key, value=new_value, mask=new_mask, length=start + S)
+            key, value = new_key, new_value
+            k_positions = jnp.arange(max_len)
+            q_positions = start + jnp.arange(q_len) + (1 if static_kv_first else 0)
+            valid_k = k_positions < (start + S)
+            attention_mask = new_mask  # (B, max_len): full-buffer padding mask
+        else:
+            k_positions = jnp.arange(S)
+            q_positions = jnp.arange(q_len) + (1 if static_kv_first else 0)
+            valid_k = None
+            if use_cache:
+                chunk_mask = (
+                    attention_mask if attention_mask is not None else jnp.ones((B, S), dtype=bool)
+                )
+                present = KVCache(
+                    key=key, value=value, mask=chunk_mask, length=jnp.asarray(S, jnp.int32)
+                )
+
+        window = self.window_size if self.attention_type == "local" else None
+        causal = make_causal_mask(q_positions, k_positions, window)  # (Q, K)
+
+        # fp32 logits for numerical parity with the reference.
+        attn_weights = jnp.einsum(
+            "bhqd,bhkd->bhqk", query.astype(jnp.float32), key.astype(jnp.float32)
+        )
+        mask = causal[None, None]
+        if valid_k is not None:
+            mask = mask & valid_k[None, None, None, :]
+        attn_weights = jnp.where(mask, attn_weights, jnp.finfo(jnp.float32).min)
+
+        if attention_mask is not None:
+            # (B, K) boolean padding mask -> additive, matching expand_mask
+            # (transformer.py:28-45).
+            additive = jnp.where(attention_mask[:, None, None, :], 0.0, jnp.finfo(jnp.float32).min)
+            attn_weights = attn_weights + additive
+
+        # Clamp so stacked masks cannot overflow to -inf: a fully-masked row
+        # then softmaxes to uniform (finite) rather than NaN.
+        attn_weights = jnp.maximum(attn_weights, jnp.finfo(jnp.float32).min)
+        attn_weights = jax.nn.softmax(attn_weights, axis=-1).astype(value.dtype)
+        attn_dropout = nn.Dropout(rate=float(cfg.attention_dropout), name="attn_dropout")
+        attn_weights = attn_dropout(attn_weights, deterministic=not self.has_rng("dropout"))
+
+        attn_output = jnp.einsum("bhqk,bhkd->bhqd", attn_weights, value)
+        attn_output = attn_output.swapaxes(-3, -2).reshape(B, q_len, embed_dim)
+        attn_output = out_proj(attn_output)
+        resid_dropout = nn.Dropout(rate=float(cfg.resid_dropout), name="resid_dropout")
+        attn_output = resid_dropout(attn_output, deterministic=not self.has_rng("dropout"))
+
+        outputs = {"present_key_value": present}
+        if output_attentions:
+            outputs["attn_weights"] = attn_weights
+        return attn_output, outputs
+
+
+class InnerAttention(nn.Module):
+    """LayerNorm + attention-type dispatch (reference ``transformer.py:285``)."""
+
+    config: StructuredTransformerConfig
+    layer_id: int = 0
+    is_seq: bool = True
+
+    @nn.compact
+    def __call__(self, hidden_states, **kwargs):
+        cfg = self.config
+        layers = cfg.seq_attention_layers if self.is_seq else cfg.dep_graph_attention_layers
+        attention_type = layers[self.layer_id]
+        if attention_type == "local":
+            window_size = cfg.seq_window_size if self.is_seq else cfg.dep_graph_window_size
+        else:
+            window_size = None
+        if attention_type not in ("global", "local"):
+            raise ValueError(
+                "Only attn layer types 'global' and 'local' exist, but got `config.attention_layers`: "
+                f"{layers}. Select attn layer types from ['global', 'local'] only."
+            )
+        normed = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="layer_norm")(hidden_states)
+        return InnerSelfAttention(
+            cfg, attention_type=attention_type, window_size=window_size, name="attention"
+        )(normed, **kwargs)
+
+
+class InnerMLP(nn.Module):
+    """Feed-forward block (reference ``transformer.py:361``)."""
+
+    config: StructuredTransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states):
+        cfg = self.config
+        inner_dim = cfg.intermediate_size if cfg.intermediate_size is not None else 4 * cfg.hidden_size
+        dense_init = nn.initializers.normal(stddev=cfg.init_std)
+        h = nn.Dense(inner_dim, kernel_init=dense_init, name="c_fc")(hidden_states)
+        h = ACT2FN[cfg.activation_function](h)
+        h = nn.Dense(cfg.hidden_size, kernel_init=dense_init, name="c_proj")(h)
+        return nn.Dropout(rate=float(cfg.resid_dropout))(h, deterministic=not self.has_rng("dropout"))
+
+
+class InnerBlock(nn.Module):
+    """Pre-LN attention + MLP residual block (reference ``transformer.py:394``)."""
+
+    config: StructuredTransformerConfig
+    layer_id: int = 0
+    is_seq: bool = True
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states,
+        attention_mask=None,
+        layer_past=None,
+        use_cache=False,
+        output_attentions=False,
+        static_kv_first: bool = False,
+    ):
+        residual = hidden_states if not static_kv_first else hidden_states[:, 1:, :]
+
+        attn_output, outputs = InnerAttention(self.config, self.layer_id, self.is_seq, name="attn")(
+            hidden_states,
+            attention_mask=attention_mask,
+            layer_past=layer_past,
+            use_cache=use_cache,
+            output_attentions=output_attentions,
+            static_kv_first=static_kv_first,
+        )
+        hidden_states = attn_output + residual
+
+        residual = hidden_states
+        normed = nn.LayerNorm(epsilon=self.config.layer_norm_epsilon, name="layer_norm")(hidden_states)
+        feed_forward = InnerMLP(self.config, name="mlp")(normed)
+        hidden_states = residual + feed_forward
+
+        if not use_cache:
+            outputs.pop("present_key_value", None)
+        return hidden_states, outputs
+
+
+class ConditionallyIndependentPointProcessInputLayer(nn.Module):
+    """Data embedding + temporal encoding for CI models (``transformer.py:622``)."""
+
+    config: StructuredTransformerConfig
+
+    @nn.compact
+    def __call__(self, batch: EventStreamBatch) -> Array:
+        cfg = self.config
+        data_embed = DataEmbeddingLayer(
+            n_total_embeddings=max(cfg.vocab_size, 1),
+            out_dim=cfg.hidden_size,
+            categorical_embedding_dim=cfg.categorical_embedding_dim,
+            numerical_embedding_dim=cfg.numerical_embedding_dim,
+            static_embedding_mode=cfg.static_embedding_mode,
+            split_by_measurement_indices=None,
+            do_normalize_by_measurement_index=cfg.do_normalize_by_measurement_index,
+            static_weight=cfg.static_embedding_weight,
+            dynamic_weight=cfg.dynamic_embedding_weight,
+            categorical_weight=cfg.categorical_embedding_weight,
+            numerical_weight=cfg.numerical_embedding_weight,
+            name="data_embedding_layer",
+        )(batch)
+        t = batch.time if batch.time is not None else time_from_deltas(batch)
+        time_embed = TemporalPositionEncoding(embedding_dim=cfg.hidden_size, name="time_embedding_layer")(t)
+        embed = data_embed + time_embed
+
+        if batch.event_mask is not None:
+            embed = jnp.where(batch.event_mask[..., None], embed, 0.0)
+
+        return nn.Dropout(rate=float(cfg.input_dropout))(embed, deterministic=not self.has_rng("dropout"))
+
+
+class ConditionallyIndependentPointProcessTransformer(nn.Module):
+    """Stack of `InnerBlock`s over whole-event embeddings.
+
+    Reference: ``transformer.py:675-848``. Gradient checkpointing is applied
+    per block via ``nn.remat`` when ``use_gradient_checkpointing`` is set.
+    """
+
+    config: StructuredTransformerConfig
+    use_gradient_checkpointing: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        batch: EventStreamBatch | None = None,
+        input_embeds: Array | None = None,
+        past: tuple[KVCache, ...] | None = None,
+        use_cache: bool = False,
+        output_attentions: bool = False,
+        output_hidden_states: bool = False,
+    ) -> TransformerOutputWithPast:
+        cfg = self.config
+        if input_embeds is None:
+            input_embeds = ConditionallyIndependentPointProcessInputLayer(cfg, name="input_layer")(batch)
+
+        # Chunk-local padding mask; with a cache, each attention layer splices
+        # these bits into its KVCache.mask to recover the full-buffer mask.
+        attention_mask = batch.event_mask if batch is not None else None
+
+        hidden_states = input_embeds
+        presents = [] if use_cache else None
+        all_attentions = [] if output_attentions else None
+        all_hidden = [] if output_hidden_states else None
+
+        block_cls = InnerBlock
+        if self.use_gradient_checkpointing:
+            # Args seen by the lifted transform: (module, hidden, attn_mask,
+            # layer_past, use_cache, output_attentions, static_kv_first).
+            block_cls = nn.remat(InnerBlock, static_argnums=(4, 5, 6))
+
+        for i in range(cfg.num_hidden_layers):
+            if all_hidden is not None:
+                all_hidden.append(hidden_states)
+            layer_past = past[i] if past is not None else None
+            block = block_cls(cfg, layer_id=i, is_seq=True, name=f"h{i}")
+            hidden_states, outputs = block(
+                hidden_states,
+                attention_mask,
+                layer_past,
+                use_cache,
+                output_attentions,
+                False,
+            )
+            # Reference parity: zero masked events' hidden states between
+            # layers (``transformer.py:820-825``).
+            if batch is not None and batch.event_mask is not None:
+                hidden_states = jnp.where(batch.event_mask[..., None], hidden_states, 0.0)
+            if presents is not None:
+                presents.append(outputs.get("present_key_value"))
+            if all_attentions is not None:
+                all_attentions.append(outputs.get("attn_weights"))
+
+        hidden_states = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(hidden_states)
+        if all_hidden is not None:
+            all_hidden.append(hidden_states)
+
+        return TransformerOutputWithPast(
+            last_hidden_state=hidden_states,
+            past_key_values=tuple(presents) if presents is not None else None,
+            hidden_states=tuple(all_hidden) if all_hidden is not None else None,
+            attentions=tuple(all_attentions) if all_attentions is not None else None,
+        )

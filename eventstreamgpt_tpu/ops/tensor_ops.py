@@ -151,7 +151,7 @@ def embedding_bag(
     Returns:
         ``(..., dim)`` summed embeddings.
     """
-    gathered = jnp.take(table, indices, axis=0)  # (..., M, dim)
+    gathered = jnp.take(table, indices, axis=0, mode="clip")  # (..., M, dim)
     pad_mask = (indices != 0).astype(gathered.dtype)
     w = pad_mask if weights is None else weights.astype(gathered.dtype) * pad_mask
     return jnp.einsum("...md,...m->...d", gathered, w)

@@ -216,10 +216,17 @@ def parse_override_value(raw: str) -> Any:
 
 
 def parse_overrides(argv: list[str]) -> dict[str, Any]:
-    """Parses ``key=value`` CLI args (Hydra syntax) into a nested dict."""
+    """Parses ``key=value`` CLI args (Hydra syntax) into a nested dict.
+
+    Hydra's bare ``~key`` deletion syntax sets the key to None; other
+    ``=``-less tokens are rejected loudly rather than silently dropped.
+    """
     out: dict[str, Any] = {}
     for arg in argv:
         if "=" not in arg:
+            if arg.startswith("~"):
+                set_dotted(out, arg[1:], None)
+                continue
             raise ValueError(f"Override {arg!r} is not of the form key=value")
         key, _, raw = arg.partition("=")
         key = key.lstrip("+~")  # hydra's +key= / ~key syntax: treat as plain set
