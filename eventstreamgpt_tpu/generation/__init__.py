@@ -1,0 +1,15 @@
+"""Autoregressive generation: sampling, fixed-shape batch updates, the loop."""
+
+from .sampling import (  # noqa: F401
+    GenerativeSequenceModelSamples,
+    append_new_event,
+    compact_data_elements,
+    sample_predictions,
+    update_last_event_data,
+)
+from .generation_utils import generate  # noqa: F401
+from .stopping_criteria import (  # noqa: F401
+    MaxLengthCriteria,
+    StoppingCriteria,
+    StoppingCriteriaList,
+)

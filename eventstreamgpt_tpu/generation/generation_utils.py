@@ -1,0 +1,322 @@
+"""The autoregressive generation loop.
+
+Rebuild of ``/root/reference/EventStream/transformer/generation/generation_utils.py``
+(``StructuredGenerationMixin.generate`` ``:124-308`` and the per-mode event
+samplers ``:310-416``) as a function over flax models.
+
+Structure under XLA: the output batch is **preallocated** to
+``input_len + max_new_events`` events and every step writes through a cursor,
+so each step is a fixed-shape jitted computation (compiled once for the
+cached single-event step; once more for the initial prefix pass). The CI path
+runs one forward per event; the NA path one forward per dependency-graph
+element per event, using the three-phase cache machine of
+`NestedAttentionPointProcessTransformer`.
+
+Deliberate divergence: the reference's *uncached* NA generation slices input
+embeddings per dep-graph target, attending over a smaller key set than the
+training forward (``transformer.py:918-927``); here the uncached NA path runs
+full forwards (target=None) each step, which provably matches the cached path
+and the training-time attention pattern (see
+``tests/models/test_na_model.py::test_cached_dep_graph_decode_matches_uncached``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.types import EventStreamBatch
+from ..models.config import StructuredEventProcessingMode, StructuredTransformerConfig
+from ..models.transformer import NAPast, init_kv_caches, time_from_deltas
+from .sampling import append_new_event, sample_predictions, update_last_event_data
+
+Array = Any
+
+
+def _preallocate(batch: EventStreamBatch, max_new_events: int) -> EventStreamBatch:
+    """Right-pads the sequence axis with ``max_new_events`` empty events."""
+
+    def pad_seq(x, fill=0):
+        if x is None:
+            return None
+        pad = [(0, 0)] * x.ndim
+        pad[1] = (0, max_new_events)
+        return jnp.pad(x, pad, constant_values=fill)
+
+    return batch.replace(
+        event_mask=pad_seq(batch.event_mask, False),
+        time_delta=pad_seq(batch.time_delta),
+        time=None,  # recomputed from deltas as needed
+        dynamic_indices=pad_seq(batch.dynamic_indices),
+        dynamic_measurement_indices=pad_seq(batch.dynamic_measurement_indices),
+        dynamic_values=pad_seq(batch.dynamic_values),
+        dynamic_values_mask=pad_seq(batch.dynamic_values_mask),
+    )
+
+
+def _slice_preds_at(preds, idx: Array):
+    """Slices (B, L, ...) prediction pytrees down to event ``idx``: (B, ...)."""
+
+    def take(x):
+        if x is None:
+            return None
+        sel = jnp.asarray(idx).reshape((1,) * x.ndim)
+        sel = jnp.broadcast_to(sel, x.shape[:1] + (1,) + x.shape[2:])
+        return jnp.take_along_axis(x, sel, axis=1)[:, 0]
+
+    return jax.tree_util.tree_map(take, preds)
+
+
+def _trim_to_event(batch: EventStreamBatch, idx: Array) -> EventStreamBatch:
+    """A one-event view of the batch at event ``idx``, with absolute time set.
+
+    Mirrors ``prepare_inputs_for_generation`` trimming
+    (``conditionally_independent_model.py:198-248``).
+    """
+    B = batch.event_mask.shape[0]
+    t_full = time_from_deltas(batch)
+
+    def take2(x):  # (B, L) -> (B, 1)
+        return jnp.take_along_axis(x, jnp.broadcast_to(idx, (B,))[:, None], axis=1)
+
+    def take3(x):  # (B, L, M) -> (B, 1, M)
+        return jnp.take_along_axis(x, jnp.broadcast_to(idx, (B,))[:, None, None], axis=1)
+
+    return batch.replace(
+        event_mask=take2(batch.event_mask),
+        time_delta=take2(batch.time_delta),
+        time=take2(t_full),
+        dynamic_indices=take3(batch.dynamic_indices),
+        dynamic_measurement_indices=take3(batch.dynamic_measurement_indices),
+        dynamic_values=take3(batch.dynamic_values),
+        dynamic_values_mask=take3(batch.dynamic_values_mask),
+    )
+
+
+def _mask_through_cursor(batch: EventStreamBatch, cursor: Array) -> EventStreamBatch:
+    """Event mask restricted to positions < cursor (hides preallocated tail)."""
+    positions = jnp.arange(batch.sequence_length)[None, :]
+    return batch.replace(event_mask=batch.event_mask & (positions < cursor))
+
+
+def generate(
+    model,
+    params,
+    batch: EventStreamBatch,
+    config: StructuredTransformerConfig,
+    key: jax.Array,
+    max_new_events: int | None = None,
+    max_length: int | None = None,
+    num_return_sequences: int = 1,
+    use_cache: bool = True,
+) -> EventStreamBatch:
+    """Autoregressively samples future events (reference ``generate`` ``:124``).
+
+    Args:
+        model: A `CIPPTForGenerativeSequenceModeling` or
+            `NAPPTForGenerativeSequenceModeling` module instance.
+        params: Model parameters.
+        batch: The prompt batch. Every sequence should be **right-aligned
+            real events** (no interior padding); the returned batch has the
+            prompt in place and generated events appended at the cursor.
+        config: The model configuration.
+        key: PRNG key for sampling.
+        max_new_events: Number of events to generate. Exactly one of this and
+            ``max_length`` must be set (or ``max_length`` defaults to
+            ``config.max_seq_len`` as in the reference ``:176-207``).
+        num_return_sequences: Sample count per prompt element; the batch is
+            expanded in-order (reference ``:216``).
+        use_cache: Use KV caches (one forward per new event/element) instead
+            of full forwards each step.
+
+    Returns:
+        The completed `EventStreamBatch` of ``input_len + max_new_events``
+        events.
+    """
+    input_len = batch.sequence_length
+    if max_new_events is None:
+        if max_length is None:
+            max_length = config.max_seq_len
+        max_new_events = max_length - input_len
+    if max_new_events <= 0:
+        raise ValueError(f"max_new_events must be positive; got {max_new_events}")
+
+    if num_return_sequences > 1:
+        batch = batch.repeat_batch_elements(num_return_sequences)
+
+    mode = config.structured_event_processing_mode
+    if mode == StructuredEventProcessingMode.CONDITIONALLY_INDEPENDENT:
+        return _generate_ci(model, params, batch, config, key, max_new_events, use_cache)
+    return _generate_na(model, params, batch, config, key, max_new_events, use_cache)
+
+
+# ------------------------------------------------------------------- CI path
+def _generate_ci(model, params, batch, config, key, max_new_events, use_cache):
+    B = batch.batch_size
+    input_len = batch.sequence_length
+    total_len = input_len + max_new_events
+    big = _preallocate(batch, max_new_events)
+    cursor = jnp.asarray(input_len, jnp.int32)
+
+    caches = None
+    if use_cache:
+
+        @jax.jit
+        def prefix_step(params, big_batch):
+            view = big_batch.slice((slice(None), slice(0, input_len)))
+            out = model.apply(
+                params,
+                view,
+                past=init_kv_caches(config, B, max_len=total_len),
+                use_cache=True,
+                is_generation=True,
+            )
+            return out.preds, out.past_key_values
+
+        @jax.jit
+        def decode_step(params, big_batch, caches, cursor):
+            view = _trim_to_event(big_batch, cursor - 1)
+            out = model.apply(params, view, past=caches, use_cache=True, is_generation=True)
+            return out.preds, out.past_key_values
+
+    @jax.jit
+    def full_step(params, big_batch, cursor):
+        masked = _mask_through_cursor(big_batch, cursor)
+        out = model.apply(params, masked, is_generation=True)
+        return out.preds
+
+    @jax.jit
+    def sample_and_write(params, big_batch, preds_last, cursor, key):
+        bcols = jnp.arange(B)
+        event_mask_last = big_batch.event_mask[bcols, cursor - 1]
+        sample = sample_predictions(preds_last, event_mask_last, key)
+        new_batch = append_new_event(big_batch, sample, config, cursor)
+        return update_last_event_data(new_batch, sample, config, cursor + 1)
+
+    for step in range(max_new_events):
+        key, step_key = jax.random.split(key)
+        if use_cache:
+            if step == 0:
+                preds, caches = prefix_step(params, big)
+                preds_last = _slice_preds_at(preds, cursor - 1)
+            else:
+                preds, caches = decode_step(params, big, caches, cursor)
+                preds_last = _slice_preds_at(preds, jnp.asarray(0))
+        else:
+            preds = full_step(params, big, cursor)
+            preds_last = _slice_preds_at(preds, cursor - 1)
+        big = sample_and_write(params, big, preds_last, cursor, step_key)
+        cursor = cursor + 1
+
+    return _mask_through_cursor(big, cursor)
+
+
+# ------------------------------------------------------------------- NA path
+def _generate_na(model, params, batch, config, key, max_new_events, use_cache):
+    B = batch.batch_size
+    input_len = batch.sequence_length
+    total_len = input_len + max_new_events
+    big = _preallocate(batch, max_new_events)
+    cursor = jnp.asarray(input_len, jnp.int32)
+
+    measurements_to_fill_list = [{"time"}, *config.measurements_per_dep_graph_level[1:]]
+    n_levels = len(measurements_to_fill_list)
+
+    past = None
+    if use_cache:
+
+        @jax.jit
+        def prefix_step(params, big_batch):
+            view = big_batch.slice((slice(None), slice(0, input_len)))
+            out = model.apply(
+                params,
+                view,
+                past=NAPast(seq_past=init_kv_caches(config, B, max_len=total_len), dep_graph_past=None),
+                use_cache=True,
+                is_generation=True,
+            )
+            return out.preds, out.past_key_values
+
+        def make_target_step(target):
+            @jax.jit
+            def target_step(params, big_batch, past, event_idx):
+                view = _trim_to_event(big_batch, event_idx)
+                out = model.apply(
+                    params,
+                    view,
+                    past=past,
+                    use_cache=True,
+                    is_generation=True,
+                    dep_graph_el_generation_target=target,
+                )
+                return out.preds, out.past_key_values
+
+            return target_step
+
+        target_steps = {t: make_target_step(t) for t in range(n_levels)}
+    else:
+
+        @jax.jit
+        def full_step(params, big_batch, cursor):
+            masked = _mask_through_cursor(big_batch, cursor)
+            out = model.apply(params, masked, is_generation=True)
+            return out.preds
+
+    @jax.jit
+    def do_append(params, big_batch, preds_last, cursor, key):
+        bcols = jnp.arange(B)
+        event_mask_last = big_batch.event_mask[bcols, cursor - 1]
+        sample = sample_predictions(preds_last, event_mask_last, key)
+        return append_new_event(big_batch, sample, config, cursor)
+
+    def make_do_fill(measurements_to_fill):
+        frozen = tuple(sorted(measurements_to_fill, key=str))
+
+        @jax.jit
+        def do_fill(params, big_batch, preds_last, cursor, key):
+            bcols = jnp.arange(B)
+            event_mask_last = big_batch.event_mask[bcols, cursor - 1]
+            sample = sample_predictions(preds_last, event_mask_last, key)
+            return update_last_event_data(
+                big_batch, sample, config, cursor, measurements_to_fill=set(frozen)
+            )
+
+        return do_fill
+
+    do_fills = [None] + [make_do_fill(m) for m in measurements_to_fill_list[1:]]
+
+    for step in range(max_new_events):
+        for level, measurements_to_fill in enumerate(measurements_to_fill_list):
+            key, step_key = jax.random.split(key)
+            is_first = step == 0
+
+            if use_cache:
+                if is_first and level == 0:
+                    preds, past = prefix_step(params, big)
+                    preds_last = _slice_preds_at(preds, cursor - 1)
+                elif level == 0:
+                    # Contextualize the just-completed event (target=0).
+                    preds, past = target_steps[0](params, big, past, cursor - 1)
+                    preds_last = _slice_preds_at(preds, jnp.asarray(0))
+                else:
+                    # Decode one new graph element of the in-progress event.
+                    preds, past = target_steps[level](params, big, past, cursor)
+                    preds_last = _slice_preds_at(preds, jnp.asarray(0))
+            else:
+                if level == 0:
+                    preds = full_step(params, big, cursor)
+                    preds_last = _slice_preds_at(preds, cursor - 1)
+                else:
+                    preds = full_step(params, big, cursor + 1)
+                    preds_last = _slice_preds_at(preds, cursor)
+
+            if measurements_to_fill == {"time"}:
+                big = do_append(params, big, preds_last, cursor, step_key)
+            else:
+                big = do_fills[level](params, big, preds_last, cursor + 1, step_key)
+        cursor = cursor + 1
+
+    return _mask_through_cursor(big, cursor)

@@ -1,0 +1,447 @@
+"""Sampling from model predictions and fixed-shape batch updates.
+
+Rebuild of the generation plumbing in
+``/root/reference/EventStream/transformer/model_output.py`` (``sample``
+``:1093``, ``_build_new_batch_element`` ``:279``,
+``format_updates_to_last_batch_event`` ``:392``, ``append_to_batch`` ``:862``,
+``update_last_event_data`` ``:944``, ``strip_unused_indices`` ``:108``).
+
+The reference grows batches by concatenation and compacts data elements with
+data-dependent shapes — neither compiles under XLA. Here the generation batch
+is **preallocated** to its final size and a write cursor tracks the number of
+real events; sampled content is written with ``.at[]`` updates at static
+layouts (one slot per single-label/univariate measurement, ``vocab_size``
+slots per multi-label/multivariate measurement, zeros where unsampled —
+index 0 is padding so unsampled slots are inert), then compacted with a
+stable sort on ``index == 0`` (the static-shape equivalent of
+``strip_unused_indices``) and truncated to the batch's data-element width.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..data.types import DataModality, EventStreamBatch, TemporalityType
+from ..distributions import Bernoulli, Categorical
+from ..models.config import StructuredTransformerConfig
+from ..models.embedding import MeasIndexGroupOptions
+from ..models.model_output import GenerativeSequenceModelPredictions
+from ..ops import expand_indexed_regression
+
+Array = Any
+
+
+@struct.dataclass
+class GenerativeSequenceModelSamples:
+    """One sampled event (reference ``model_output.py:246``)."""
+
+    event_mask: Array  # (B,)
+    time_to_event: Optional[Array] = None  # (B,)
+    classification: Optional[dict[str, Array]] = None
+    regression: Optional[dict[str, Array]] = None
+    regression_indices: Optional[dict[str, Array]] = None
+
+
+def _named_key(key: jax.Array, name: str) -> jax.Array:
+    """A PRNG key derived stably from ``name``.
+
+    Keys are bound to measurement names (via crc32, which is stable across
+    processes, unlike ``hash``) rather than dict position, so a cached decode
+    that sees only one level's predictions samples identically to an uncached
+    full forward that sees them all.
+    """
+    return jax.random.fold_in(key, zlib.crc32(name.encode()) & 0x7FFFFFFF)
+
+
+def sample_predictions(
+    preds: GenerativeSequenceModelPredictions, event_mask: Array, key: jax.Array
+) -> GenerativeSequenceModelSamples:
+    """Samples an event from per-head predictions (reference ``:1093``).
+
+    ``preds`` must already be sliced to the source event (trailing sequence
+    dim removed). ``event_mask`` is the (B,) mask for the sampled event.
+    """
+    sampled_classification = None
+    if preds.classification is not None:
+        sampled_classification = {}
+        for k, (is_obs_dist, dist) in preds.classification.items():
+            if is_obs_dist is None:
+                sampled_classification[k] = dist.sample(_named_key(key, f"cls:{k}"))
+            elif isinstance(dist, Categorical):
+                is_obs = is_obs_dist.sample(_named_key(key, f"cls_obs:{k}")) == 1
+                samp = dist.sample(_named_key(key, f"cls:{k}"))
+                sampled_classification[k] = jnp.where(is_obs, samp, 0)
+            else:
+                raise ValueError(f"Don't know how to sample classification dist {dist}!")
+
+    sampled_regression = None
+    if preds.regression is not None:
+        sampled_regression = {}
+        for k, (is_obs_dist, dist) in preds.regression.items():
+            samp = dist.sample(_named_key(key, f"reg:{k}"))
+            if is_obs_dist is None:
+                sampled_regression[k] = samp
+            else:
+                is_obs = is_obs_dist.sample(_named_key(key, f"reg_obs:{k}")) == 1
+                is_obs = jnp.broadcast_to(is_obs[..., None], samp.shape)
+                sampled_regression[k] = jnp.where(is_obs, samp, jnp.nan)
+
+    time_to_event = None
+    if preds.time_to_event is not None:
+        time_to_event = preds.time_to_event.sample(_named_key(key, "tte"))
+        # Reference clamps +inf to 1000 (noting its own hack; ``:1155``).
+        time_to_event = jnp.nan_to_num(time_to_event, posinf=1000.0)
+
+    return GenerativeSequenceModelSamples(
+        event_mask=event_mask,
+        time_to_event=time_to_event,
+        classification=sampled_classification,
+        regression=sampled_regression,
+        regression_indices=preds.regression_indices,
+    )
+
+
+def compact_data_elements(
+    dynamic_indices: Array,
+    dynamic_measurement_indices: Array,
+    dynamic_values: Array,
+    dynamic_values_mask: Array,
+    out_width: int,
+):
+    """Static-shape ``strip_unused_indices`` (reference ``:108``): moves
+    nonzero-index elements to the front via stable sort, truncates/pads to
+    ``out_width``."""
+    order = jnp.argsort(dynamic_indices == 0, axis=-1, stable=True)
+
+    def take(x):
+        return jnp.take_along_axis(x, order, axis=-1)
+
+    di = take(dynamic_indices)
+    dmi = take(dynamic_measurement_indices)
+    dv = take(dynamic_values)
+    dvm = take(dynamic_values_mask)
+
+    cur = di.shape[-1]
+    if cur >= out_width:
+        di, dmi, dv, dvm = di[..., :out_width], dmi[..., :out_width], dv[..., :out_width], dvm[..., :out_width]
+    else:
+        pad = [(0, 0)] * (di.ndim - 1) + [(0, out_width - cur)]
+        di = jnp.pad(di, pad)
+        dmi = jnp.pad(dmi, pad)
+        dv = jnp.pad(dv, pad)
+        dvm = jnp.pad(dvm, pad)
+    # Zero out everything tied to padding indices.
+    valid = di != 0
+    return di, jnp.where(valid, dmi, 0), jnp.where(valid & dvm, dv, 0.0), valid & dvm
+
+
+def _functor_elements(
+    sample: GenerativeSequenceModelSamples,
+    batch: EventStreamBatch,
+    config: StructuredTransformerConfig,
+    cursor: Array,
+):
+    """Computes FUNCTIONAL_TIME_DEPENDENT elements for the new event.
+
+    Reference ``_build_new_batch_element`` ``:318-358``: one element per
+    functor measurement, updated analytically from the prior event.
+    """
+    B = batch.event_mask.shape[0]
+    prior_idx = cursor - 1
+
+    def at_prior(x):
+        """Gathers each row's prior-event slice: (B, L, M) -> (B, M)."""
+        sel = jnp.broadcast_to(prior_idx, (B,))[:, None, None]
+        return jnp.take_along_axis(x, sel, axis=1)[:, 0]
+
+    prior_indices_all = at_prior(batch.dynamic_indices)
+    prior_meas_all = at_prior(batch.dynamic_measurement_indices)
+    prior_vals_all = at_prior(batch.dynamic_values)
+    prior_vmask_all = at_prior(batch.dynamic_values_mask)
+
+    # New absolute time (minutes since epoch): start_time + duration-so-far +
+    # sampled TTE. Durations exclude the filler delta at the prior event.
+    positions = jnp.arange(batch.sequence_length)[None, :]
+    deltas_before = jnp.where(
+        (positions < prior_idx) & batch.event_mask, batch.time_delta, 0.0
+    ).sum(-1)
+    start_time = batch.start_time if batch.start_time is not None else jnp.zeros((B,))
+    new_time = jnp.where(
+        sample.event_mask, start_time + deltas_before + sample.time_to_event, 0.0
+    )
+
+    els_idx, els_meas, els_val, els_vmask = [], [], [], []
+    for m, cfg in config.measurement_configs.items():
+        if cfg.temporality != TemporalityType.FUNCTIONAL_TIME_DEPENDENT:
+            continue
+        if cfg.modality == DataModality.DROPPED:
+            continue
+        meas_idx = config.measurements_idxmap[m]
+        offset = config.vocab_offsets_by_measurement[m]
+
+        is_m = prior_meas_all == meas_idx
+        indices = jnp.where(is_m, prior_indices_all, 0).sum(-1)
+        vals = jnp.where(is_m & prior_vmask_all, prior_vals_all, 0.0).sum(-1)
+
+        new_indices, new_values = cfg.functor.update_from_prior_timepoint(
+            prior_indices=indices - offset,
+            prior_values=vals,
+            new_delta=sample.time_to_event,
+            new_time=new_time,
+            vocab=cfg.vocabulary,
+            measurement_metadata=cfg.measurement_metadata,
+        )
+        new_indices = new_indices + offset
+        els_idx.append(new_indices)
+        els_meas.append(jnp.full_like(new_indices, meas_idx))
+        els_val.append(jnp.nan_to_num(new_values, nan=0.0, posinf=0.0, neginf=0.0))
+        els_vmask.append(~jnp.isnan(new_values))
+
+    if not els_idx:
+        z = jnp.zeros((B, 0), dtype=batch.dynamic_indices.dtype)
+        return z, z, z.astype(jnp.float32), z.astype(bool), new_time
+    return (
+        jnp.stack(els_idx, -1),
+        jnp.stack(els_meas, -1),
+        jnp.stack(els_val, -1),
+        jnp.stack(els_vmask, -1),
+        new_time,
+    )
+
+
+def append_new_event(
+    batch: EventStreamBatch,
+    sample: GenerativeSequenceModelSamples,
+    config: StructuredTransformerConfig,
+    cursor: Array,
+) -> EventStreamBatch:
+    """Writes the sampled TTE + functor elements as event ``cursor``.
+
+    Equivalent to the reference ``append_to_batch`` (``:862``) on a
+    preallocated batch: ``time_delta[cursor-1] = TTE``; the new event gets the
+    filler delta 1, the sampled event mask, and functor-computed elements.
+    """
+    B, L, M = batch.dynamic_indices.shape
+    f_idx, f_meas, f_val, f_vmask, _ = _functor_elements(sample, batch, config, cursor)
+    nf = f_idx.shape[-1]
+
+    bcols = jnp.arange(B)
+    time_delta = batch.time_delta.at[bcols, cursor - 1].set(
+        jnp.where(sample.event_mask, sample.time_to_event, batch.time_delta[bcols, cursor - 1])
+    )
+    time_delta = time_delta.at[bcols, cursor].set(1.0)
+    event_mask = batch.event_mask.at[bcols, cursor].set(sample.event_mask)
+
+    new_idx = jnp.zeros((B, M), dtype=batch.dynamic_indices.dtype)
+    new_meas = jnp.zeros((B, M), dtype=batch.dynamic_measurement_indices.dtype)
+    new_val = jnp.zeros((B, M), dtype=batch.dynamic_values.dtype)
+    new_vmask = jnp.zeros((B, M), dtype=bool)
+    if nf > 0:
+        new_idx = new_idx.at[:, :nf].set(f_idx)
+        new_meas = new_meas.at[:, :nf].set(f_meas)
+        new_val = new_val.at[:, :nf].set(f_val)
+        new_vmask = new_vmask.at[:, :nf].set(f_vmask)
+
+    # Zero content for non-events.
+    em = sample.event_mask[:, None]
+    new_idx = jnp.where(em, new_idx, 0)
+    new_meas = jnp.where(em, new_meas, 0)
+    new_val = jnp.where(em, new_val, 0.0)
+    new_vmask = new_vmask & em
+
+    return batch.replace(
+        time_delta=time_delta,
+        event_mask=event_mask,
+        dynamic_indices=batch.dynamic_indices.at[bcols, cursor].set(new_idx),
+        dynamic_measurement_indices=batch.dynamic_measurement_indices.at[bcols, cursor].set(new_meas),
+        dynamic_values=batch.dynamic_values.at[bcols, cursor].set(new_val),
+        dynamic_values_mask=batch.dynamic_values_mask.at[bcols, cursor].set(new_vmask),
+    )
+
+
+def _format_new_elements(
+    sample: GenerativeSequenceModelSamples,
+    batch: EventStreamBatch,
+    config: StructuredTransformerConfig,
+    measurements_to_fill,
+    cursor: Array,
+):
+    """Fixed-layout content arrays for the sampled measurements.
+
+    Reference ``format_updates_to_last_batch_event`` (``:392``), with zeros in
+    unsampled slots instead of dynamic stripping.
+    """
+    B = batch.event_mask.shape[0]
+    idx_parts, meas_parts, val_parts, vmask_parts = [], [], [], []
+
+    def add_single_label(m):
+        offset = config.vocab_offsets_by_measurement[m]
+        preds = sample.classification[m]
+        indices = (offset + preds)[:, None]
+        idx_parts.append(indices)
+        meas_parts.append(jnp.full_like(indices, config.measurements_idxmap[m]))
+        val_parts.append(jnp.zeros_like(indices, dtype=jnp.float32))
+        vmask_parts.append(jnp.zeros_like(indices, dtype=bool))
+
+    def add_multi_label(m):
+        offset = config.vocab_offsets_by_measurement[m]
+        V = config.vocab_sizes_by_measurement[m]
+        preds = sample.classification[m]  # (B, V) binary
+        indices = jnp.where(preds == 1, jnp.arange(V)[None, :] + offset, 0)
+        idx_parts.append(indices)
+        meas_parts.append(jnp.where(indices != 0, config.measurements_idxmap[m], 0))
+        return indices
+
+    def add_multivariate_regression(m, indices):
+        offset = config.vocab_offsets_by_measurement[m]
+        V = config.vocab_sizes_by_measurement[m]
+        regressed = sample.regression[m]
+        regressed_mask = jnp.ones_like(regressed, dtype=bool)
+        if (
+            sample.regression_indices is not None
+            and m in sample.regression_indices
+            and sample.regression_indices[m] is not None
+        ):
+            ridx = sample.regression_indices[m]
+            regressed = expand_indexed_regression(jnp.nan_to_num(regressed, nan=0.0), ridx, V)
+            regressed_mask = (
+                expand_indexed_regression(regressed_mask.astype(jnp.float32), ridx, V) > 0
+            )
+        mask = indices >= offset
+        gather_idx = jnp.where(mask, indices - offset, 0)
+        values = jnp.take_along_axis(regressed, gather_idx, axis=-1)
+        values_mask = jnp.take_along_axis(regressed_mask, gather_idx, axis=-1)
+        val_parts.append(jnp.where(mask, jnp.nan_to_num(values, nan=0.0), 0.0))
+        vmask_parts.append(jnp.where(mask, values_mask & ~jnp.isnan(values), False))
+
+    def add_univariate_regression(m):
+        preds = sample.regression[m]
+        preds = preds[..., 0] if preds.ndim == 2 else preds
+        obs = ~jnp.isnan(preds)
+        val_parts.append(jnp.nan_to_num(preds, nan=0.0)[:, None])
+        vmask_parts.append(obs[:, None])
+        idx_parts.append((config.vocab_offsets_by_measurement[m] * obs.astype(jnp.int32))[:, None])
+        meas_parts.append((config.measurements_idxmap[m] * obs.astype(jnp.int32))[:, None])
+
+    if "event_type" in measurements_to_fill:
+        add_single_label("event_type")
+
+    for m in measurements_to_fill:
+        group_mode = None
+        if isinstance(m, (tuple, list)):
+            m, group_mode = m
+        if m == "event_type":
+            continue
+        modality = config.measurement_configs[m].modality
+
+        if modality == DataModality.SINGLE_LABEL_CLASSIFICATION and group_mode is None:
+            add_single_label(m)
+        elif modality == DataModality.MULTI_LABEL_CLASSIFICATION and group_mode is None:
+            indices = add_multi_label(m)
+            val_parts.append(jnp.zeros_like(indices, dtype=jnp.float32))
+            vmask_parts.append(jnp.zeros_like(indices, dtype=bool))
+        elif modality == DataModality.UNIVARIATE_REGRESSION and group_mode is None:
+            add_univariate_regression(m)
+        elif modality == DataModality.MULTIVARIATE_REGRESSION and group_mode in (
+            None,
+            MeasIndexGroupOptions.CATEGORICAL_AND_NUMERICAL,
+        ):
+            indices = add_multi_label(m)
+            add_multivariate_regression(m, indices)
+        elif modality == DataModality.MULTIVARIATE_REGRESSION and group_mode == (
+            MeasIndexGroupOptions.CATEGORICAL_ONLY
+        ):
+            indices = add_multi_label(m)
+            val_parts.append(jnp.zeros_like(indices, dtype=jnp.float32))
+            vmask_parts.append(jnp.zeros_like(indices, dtype=bool))
+        elif modality == DataModality.MULTIVARIATE_REGRESSION and group_mode == (
+            MeasIndexGroupOptions.NUMERICAL_ONLY
+        ):
+            meas_idx = config.measurements_idxmap[m]
+            bcols = jnp.arange(B)
+            cur_meas = batch.dynamic_measurement_indices[bcols, cursor - 1]
+            cur_idx = batch.dynamic_indices[bcols, cursor - 1]
+            indices = jnp.where(cur_meas == meas_idx, cur_idx, 0)
+            idx_parts.append(indices)
+            meas_parts.append(jnp.where(indices != 0, meas_idx, 0))
+            add_multivariate_regression(m, indices)
+        else:
+            raise ValueError(f"{modality}, {group_mode} invalid!")
+
+    new_idx = jnp.concatenate(idx_parts, axis=1)
+    new_meas = jnp.concatenate(meas_parts, axis=1)
+    new_val = jnp.concatenate(val_parts, axis=1)
+    new_vmask = jnp.concatenate(vmask_parts, axis=1)
+    return new_idx, new_meas, new_val, new_vmask
+
+
+def update_last_event_data(
+    batch: EventStreamBatch,
+    sample: GenerativeSequenceModelSamples,
+    config: StructuredTransformerConfig,
+    cursor: Array,
+    measurements_to_fill=None,
+) -> EventStreamBatch:
+    """Merges sampled content into event ``cursor - 1``.
+
+    Reference ``update_last_event_data`` (``:944``): existing elements are
+    kept (minus categorical duplicates for NUMERICAL_ONLY fills), new sampled
+    elements appended, then everything is compacted to the batch's
+    data-element width.
+    """
+    if measurements_to_fill is None:
+        measurements_to_fill = ["event_type"]
+        for m, cfg in config.measurement_configs.items():
+            if not cfg.is_dropped and cfg.temporality == TemporalityType.DYNAMIC:
+                measurements_to_fill.append(m)
+        measurements_to_fill = set(measurements_to_fill)
+    if not measurements_to_fill:
+        return batch
+    if "time" in measurements_to_fill:
+        raise ValueError("You shouldn't ever be trying to fill the 'time' aspect of a batch!")
+
+    B, L, M = batch.dynamic_indices.shape
+    bcols = jnp.arange(B)
+    prev_idx = batch.dynamic_indices[bcols, cursor - 1]
+    prev_meas = batch.dynamic_measurement_indices[bcols, cursor - 1]
+    prev_val = batch.dynamic_values[bcols, cursor - 1]
+    prev_vmask = batch.dynamic_values_mask[bcols, cursor - 1]
+
+    drop = jnp.zeros_like(prev_idx, dtype=bool)
+    for m in measurements_to_fill:
+        if isinstance(m, (tuple, list)) and m[1] == MeasIndexGroupOptions.NUMERICAL_ONLY:
+            drop = drop | (prev_meas == config.measurements_idxmap[m[0]])
+    prev_idx = jnp.where(drop, 0, prev_idx)
+    prev_meas = jnp.where(drop, 0, prev_meas)
+    prev_val = jnp.where(drop, 0.0, prev_val)
+    prev_vmask = jnp.where(drop, False, prev_vmask)
+
+    new_idx, new_meas, new_val, new_vmask = _format_new_elements(
+        sample, batch, config, measurements_to_fill, cursor
+    )
+
+    # Only fill content for real events.
+    em = sample.event_mask[:, None]
+    new_idx = jnp.where(em, new_idx, 0)
+    new_meas = jnp.where(em, new_meas, 0)
+    new_val = jnp.where(em, new_val, 0.0)
+    new_vmask = new_vmask & em
+
+    all_idx = jnp.concatenate([prev_idx, new_idx], axis=1)
+    all_meas = jnp.concatenate([prev_meas, new_meas], axis=1)
+    all_val = jnp.concatenate([prev_val, new_val], axis=1)
+    all_vmask = jnp.concatenate([prev_vmask, new_vmask], axis=1)
+
+    di, dmi, dv, dvm = compact_data_elements(all_idx, all_meas, all_val, all_vmask, M)
+
+    return batch.replace(
+        dynamic_indices=batch.dynamic_indices.at[bcols, cursor - 1].set(di),
+        dynamic_measurement_indices=batch.dynamic_measurement_indices.at[bcols, cursor - 1].set(dmi),
+        dynamic_values=batch.dynamic_values.at[bcols, cursor - 1].set(dv),
+        dynamic_values_mask=batch.dynamic_values_mask.at[bcols, cursor - 1].set(dvm),
+    )

@@ -1,0 +1,45 @@
+"""Stopping criteria for event-stream generation.
+
+Rebuild of
+``/root/reference/EventStream/transformer/generation/generation_stopping_criteria.py``:
+an ABC judging whole batches on **event count** (not token count), a
+max-length criterion, and a list combinator.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from ..data.types import EventStreamBatch
+
+
+class StoppingCriteria(abc.ABC):
+    """Decides whether generation should stop for the whole batch."""
+
+    @abc.abstractmethod
+    def __call__(self, batch: EventStreamBatch, **kwargs) -> bool: ...
+
+
+class MaxLengthCriteria(StoppingCriteria):
+    """Stops once the batch holds ``max_length`` events (reference ``:31``)."""
+
+    def __init__(self, max_length: int):
+        self.max_length = max_length
+
+    def __call__(self, batch: EventStreamBatch, n_events: int | None = None, **kwargs) -> bool:
+        n = n_events if n_events is not None else batch.sequence_length
+        return n >= self.max_length
+
+
+class StoppingCriteriaList(list, StoppingCriteria):
+    """Stops when any member criterion fires (reference ``:50``)."""
+
+    def __call__(self, batch: EventStreamBatch, **kwargs) -> bool:
+        return any(criteria(batch, **kwargs) for criteria in self)
+
+    @property
+    def max_length(self) -> int | None:
+        for criterion in self:
+            if isinstance(criterion, MaxLengthCriteria):
+                return criterion.max_length
+        return None

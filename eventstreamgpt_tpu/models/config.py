@@ -349,7 +349,23 @@ class StructuredTransformerConfig(JSONableMixin):
         self.measurements_per_generative_mode = measurements_per_generative_mode
         self.measurements_per_dep_graph_level = measurements_per_dep_graph_level
 
-        self.vocab_size = max(sum(self.vocab_sizes_by_measurement.values()), 1)
+        # The reference constructor uses ``max(sum(sizes), 1)`` here
+        # (``config.py:804``), which under-counts the padding offset; the real
+        # value is always overwritten by ``set_to_dataset`` with
+        # ``VocabularyConfig.total_vocab_size`` (``data/config.py:583``). We
+        # apply that formula directly whenever offsets are known so
+        # standalone-constructed configs are consistent too.
+        if self.vocab_offsets_by_measurement:
+            self.vocab_size = (
+                sum(self.vocab_sizes_by_measurement.values())
+                + min(self.vocab_offsets_by_measurement.values())
+                + (
+                    len(self.vocab_offsets_by_measurement)
+                    - len(self.vocab_sizes_by_measurement)
+                )
+            )
+        else:
+            self.vocab_size = max(sum(self.vocab_sizes_by_measurement.values()), 1)
 
         self.head_dim = head_dim
         self.hidden_size = hidden_size

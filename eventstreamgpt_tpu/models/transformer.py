@@ -30,6 +30,7 @@ from flax import struct
 from ..data.types import EventStreamBatch
 from .config import StructuredTransformerConfig
 from .embedding import DataEmbeddingLayer
+from .structured_attention import StructuredAttention
 
 Array = Any
 
@@ -465,4 +466,267 @@ class ConditionallyIndependentPointProcessTransformer(nn.Module):
             past_key_values=tuple(presents) if presents is not None else None,
             hidden_states=tuple(all_hidden) if all_hidden is not None else None,
             attentions=tuple(all_attentions) if all_attentions is not None else None,
+        )
+
+
+class StructuredTransformerBlock(nn.Module):
+    """Seq + dep-graph structured block (reference ``transformer.py:464``).
+
+    The sequence and dep-graph halves are full `InnerBlock`s or bare
+    `InnerAttention`s per ``do_full_block_in_{seq,dep_graph}_attention``.
+    """
+
+    config: StructuredTransformerConfig
+    layer_id: int = 0
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        cfg = self.config
+        if cfg.do_full_block_in_seq_attention:
+            seq_module = lambda: InnerBlock(cfg, self.layer_id, is_seq=True, name="seq_block")
+        else:
+            seq_module = lambda: InnerAttention(cfg, self.layer_id, is_seq=True, name="seq_attn")
+        if cfg.do_full_block_in_dep_graph_attention:
+            dep_module = lambda: InnerBlock(cfg, self.layer_id, is_seq=False, name="dep_graph_block")
+        else:
+            dep_module = lambda: InnerAttention(cfg, self.layer_id, is_seq=False, name="dep_graph_attn")
+        return StructuredAttention(
+            seq_module=seq_module, dep_graph_module=dep_module, name="block"
+        )(*args, **kwargs)
+
+
+class NestedAttentionPointProcessInputLayer(nn.Module):
+    """Dep-graph-split input embeddings for NA models (``transformer.py:851``).
+
+    Time embeddings join graph slot 0; a cumsum over the graph axis makes the
+    final element a whole-event summary.
+    """
+
+    config: StructuredTransformerConfig
+
+    @nn.compact
+    def __call__(
+        self, batch: EventStreamBatch, dep_graph_el_generation_target: int | None = None
+    ) -> Array:
+        cfg = self.config
+        split_by_measurement_indices = []
+        for measurement_list in cfg.measurements_per_dep_graph_level:
+            out_list = []
+            for measurement in measurement_list:
+                if isinstance(measurement, str):
+                    out_list.append(cfg.measurements_idxmap[measurement])
+                elif isinstance(measurement, (tuple, list)) and len(measurement) == 2:
+                    out_list.append((cfg.measurements_idxmap[measurement[0]], measurement[1]))
+                else:
+                    raise ValueError(
+                        f"Unexpected measurement {type(measurement)}: {measurement}\n"
+                        f"{cfg.measurements_per_dep_graph_level}"
+                    )
+            split_by_measurement_indices.append(tuple(out_list))
+
+        embed = DataEmbeddingLayer(
+            n_total_embeddings=max(cfg.vocab_size, 1),
+            out_dim=cfg.hidden_size,
+            categorical_embedding_dim=cfg.categorical_embedding_dim,
+            numerical_embedding_dim=cfg.numerical_embedding_dim,
+            static_embedding_mode=cfg.static_embedding_mode,
+            split_by_measurement_indices=tuple(split_by_measurement_indices),
+            do_normalize_by_measurement_index=cfg.do_normalize_by_measurement_index,
+            static_weight=cfg.static_embedding_weight,
+            dynamic_weight=cfg.dynamic_embedding_weight,
+            categorical_weight=cfg.categorical_embedding_weight,
+            numerical_weight=cfg.numerical_embedding_weight,
+            name="data_embedding_layer",
+        )(batch)
+        # embed: (B, L, G, H)
+
+        t = batch.time if batch.time is not None else time_from_deltas(batch)
+        time_embed = TemporalPositionEncoding(embedding_dim=cfg.hidden_size, name="time_embedding_layer")(t)
+        embed = embed.at[:, :, 0, :].add(time_embed)
+
+        embed = jnp.cumsum(embed, axis=2)
+
+        if dep_graph_el_generation_target is not None:
+            # Cached generation: only the (target-1)-th graph element is new.
+            embed = embed[:, :, dep_graph_el_generation_target - 1][:, :, None, :]
+
+        if batch.event_mask is not None:
+            embed = jnp.where(batch.event_mask[:, :, None, None], embed, 0.0)
+
+        return nn.Dropout(rate=float(cfg.input_dropout))(embed, deterministic=not self.has_rng("dropout"))
+
+
+@struct.dataclass
+class NAPast:
+    """The two-level NA cache: per-layer seq caches + dep-graph caches."""
+
+    seq_past: Optional[tuple] = None
+    dep_graph_past: Optional[tuple] = None
+
+
+class NestedAttentionPointProcessTransformer(nn.Module):
+    """NA encoder: stack of `StructuredTransformerBlock`s with the three-way
+    cache state machine (reference ``transformer.py:939-1233``).
+
+    ``dep_graph_el_generation_target`` (static) selects the generation mode:
+    ``None`` = full forward; ``0`` = contextualize the just-completed event
+    and reset the dep-graph cache to the history embedding; ``>0`` = decode
+    one new graph element against the dep-graph cache.
+    """
+
+    config: StructuredTransformerConfig
+    use_gradient_checkpointing: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        batch: EventStreamBatch | None = None,
+        input_embeds: Array | None = None,
+        past: NAPast | None = None,
+        use_cache: bool = False,
+        output_attentions: bool = False,
+        output_hidden_states: bool = False,
+        dep_graph_el_generation_target: int | None = None,
+    ) -> TransformerOutputWithPast:
+        cfg = self.config
+        if input_embeds is None:
+            input_embeds = NestedAttentionPointProcessInputLayer(cfg, name="input_layer")(
+                batch, dep_graph_el_generation_target=dep_graph_el_generation_target
+            )
+            event_mask = batch.event_mask
+        else:
+            event_mask = None
+
+        seq_attention_mask = event_mask
+        hidden_states = input_embeds
+        bsz, seq_len, dep_graph_len, hidden_size = hidden_states.shape
+
+        # Static cache-mode flags (reference ``transformer.py:1043-1100``).
+        update_seq_cache = False
+        update_dep_graph_cache = False
+        re_set_dep_graph_cache = False
+        prepend_graph_with_history_embeddings = True
+        update_last_graph_el_to_history_embedding = True
+        if use_cache:
+            if dep_graph_el_generation_target is None:
+                if past is not None and past.dep_graph_past is not None:
+                    raise ValueError(
+                        "dep_graph_past should be None if gen target is None; got "
+                        f"{past.dep_graph_past}"
+                    )
+                update_seq_cache = True
+                update_dep_graph_cache = True
+                re_set_dep_graph_cache = True
+            elif dep_graph_el_generation_target == 0:
+                update_seq_cache = True
+                update_dep_graph_cache = True
+                re_set_dep_graph_cache = True
+                prepend_graph_with_history_embeddings = False
+            elif dep_graph_el_generation_target > 0:
+                update_dep_graph_cache = True
+                if past is None or past.dep_graph_past is None:
+                    raise ValueError(
+                        "dep_graph_past should not be None if dep_graph_el_generation_target is "
+                        f"{dep_graph_el_generation_target}."
+                    )
+                prepend_graph_with_history_embeddings = False
+                update_last_graph_el_to_history_embedding = False
+            else:
+                raise ValueError(
+                    "While use_cache=True, dep_graph generation target must be a non-negative int; "
+                    f"got {dep_graph_el_generation_target}."
+                )
+
+        seq_past = past.seq_past if past is not None else None
+        dep_graph_past = past.dep_graph_past if past is not None else None
+
+        presents_seq = [] if use_cache else None
+        presents_dep = [] if use_cache else None
+        all_attentions = {"seq_attentions": [], "dep_graph_attentions": []} if output_attentions else None
+        all_hidden = [] if output_hidden_states else None
+
+        for i in range(cfg.num_hidden_layers):
+            if all_hidden is not None:
+                all_hidden.append(hidden_states)
+            block = StructuredTransformerBlock(cfg, layer_id=i, name=f"h{i}")
+            hidden_states, extra = block(
+                hidden_states,
+                seq_attention_mask=seq_attention_mask,
+                event_mask=event_mask,
+                prepend_graph_with_history_embeddings=prepend_graph_with_history_embeddings,
+                update_last_graph_el_to_history_embedding=update_last_graph_el_to_history_embedding,
+                seq_module_kwargs=dict(
+                    layer_past=seq_past[i] if seq_past is not None else None,
+                    use_cache=update_seq_cache,
+                    output_attentions=output_attentions,
+                ),
+                dep_graph_module_kwargs=dict(
+                    layer_past=dep_graph_past[i] if dep_graph_past is not None else None,
+                    use_cache=update_dep_graph_cache,
+                    output_attentions=output_attentions,
+                ),
+            )
+
+            if update_seq_cache:
+                presents_seq.append(extra["seq_module"]["present_key_value"])
+            if update_dep_graph_cache:
+                presents_dep.append(extra["dep_graph_module"]["present_key_value"])
+            if output_attentions:
+                if extra["seq_module"] is not None:
+                    all_attentions["seq_attentions"].append(extra["seq_module"].get("attn_weights"))
+                all_attentions["dep_graph_attentions"].append(
+                    extra["dep_graph_module"].get("attn_weights")
+                )
+
+        hidden_states = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, name="ln_f")(hidden_states)
+
+        if all_hidden is not None:
+            all_hidden.append(hidden_states)
+
+        presents = None
+        if use_cache:
+            if not update_seq_cache:
+                presents_seq = list(seq_past) if seq_past is not None else None
+            if re_set_dep_graph_cache:
+                # Reset the dep-graph cache to a single entry: the key/value of
+                # the last event's contextualized (whole-event) embedding,
+                # which seeds the next event's dep-graph decode
+                # (``transformer.py:1194-1221``).
+                max_dep_len = dep_graph_len + 1
+                new_dep = []
+                for kv in presents_dep:
+                    # kv buffers: (B*seq_len, H, cached_len, hd); the last
+                    # written position of the last event holds the
+                    # contextualized embedding's kv.
+                    n_heads = kv.key.shape[1]
+                    hd = kv.key.shape[3]
+                    last_pos = kv.length - 1
+
+                    def last_el(x):
+                        x_last = jax.lax.dynamic_index_in_dim(x, last_pos, axis=2, keepdims=False)
+                        # (B*seq_len, H, hd) -> last event -> (B, H, hd)
+                        x_last = x_last.reshape(bsz, seq_len, n_heads, hd)[:, -1]
+                        buf = jnp.zeros((bsz, n_heads, max_dep_len, hd), dtype=x.dtype)
+                        return buf.at[:, :, 0, :].set(x_last)
+
+                    mask = jnp.zeros((bsz, max_dep_len), dtype=bool).at[:, 0].set(True)
+                    new_dep.append(
+                        KVCache(
+                            key=last_el(kv.key),
+                            value=last_el(kv.value),
+                            mask=mask,
+                            length=jnp.asarray(1, jnp.int32),
+                        )
+                    )
+                presents_dep = new_dep
+            presents = NAPast(
+                seq_past=tuple(presents_seq) if presents_seq is not None else None,
+                dep_graph_past=tuple(presents_dep) if presents_dep is not None else None,
+            )
+
+        return TransformerOutputWithPast(
+            last_hidden_state=hidden_states,
+            past_key_values=presents,
+            hidden_states=tuple(all_hidden) if all_hidden is not None else None,
+            attentions=all_attentions if all_attentions is not None else None,
         )
