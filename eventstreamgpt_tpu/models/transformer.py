@@ -692,7 +692,12 @@ class NestedAttentionPointProcessTransformer(nn.Module):
                 # the last event's contextualized (whole-event) embedding,
                 # which seeds the next event's dep-graph decode
                 # (``transformer.py:1194-1221``).
-                max_dep_len = dep_graph_len + 1
+                # Sized from static config, NOT the current input's
+                # dep_graph_len: at target=0 the input is trimmed to one graph
+                # element, but the reset buffer must still hold the history
+                # slot plus every level decoded before the next reset
+                # (targets 1..G-1 and the target=0 append).
+                max_dep_len = len(cfg.measurements_per_dep_graph_level) + 1
                 new_dep = []
                 for kv in presents_dep:
                     # kv buffers: (B*seq_len, H, cached_len, hd); the last

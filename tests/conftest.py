@@ -1,22 +1,17 @@
 """Test harness configuration.
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
-exercised without TPU hardware (SURVEY.md §4). Env vars alone are not enough
-here: the environment's sitecustomize imports jax and registers the TPU
-plugin before this file runs, so the platform must also be forced via
-``jax.config`` after import.
+exercised without TPU hardware (SURVEY.md §4). The provisioning recipe is
+shared with the driver's multi-chip dry run (``__graft_entry__.py``): env vars
+alone are not enough here because the environment's sitecustomize imports jax
+and registers the TPU plugin before this file runs, so the platform must also
+be forced via ``jax.config`` after import.
 """
 
-import os
+from __graft_entry__ import _provision_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+_provision_cpu_devices(8)
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
-
-assert len(jax.devices()) == 8, f"expected 8 virtual CPU devices, got {jax.devices()}"

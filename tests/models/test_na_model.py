@@ -144,18 +144,23 @@ class TestNAEncoder:
         np.testing.assert_allclose(np.asarray(out.last_hidden_state[-1, -1]), 0.0)
 
     def test_cached_dep_graph_decode_matches_uncached(self):
-        """The three-phase cached decode reproduces the uncached forward.
+        """The three-phase cached decode reproduces the uncached forward
+        across MULTIPLE consecutive events.
 
-        Phase 1: full cached forward over events [0, L-1) (target=None).
-        Phase 2: per-level decode of event L-1 (targets 1..G-1).
-        Phase 3: target=0 on the completed event L-1.
+        Phase 1: full cached forward over events [0, L-2) (target=None).
+        Then for each of the last two events: per-level decode (targets
+        1..G-1) followed by target=0 on the completed event. Decoding two
+        events exercises the post-reset dep-graph cache buffer — a reset
+        buffer sized from the trimmed input instead of the static config
+        overflows on the second event (silent dynamic_update_slice clamping).
         Each phase's outputs must match the corresponding slice of the
         uncached full forward.
         """
         B, L = self.batch.event_mask.shape
+        n_decode = 2  # decode the last two events through the cached machine
         full = self.encoder.apply(self.params, self.batch)
 
-        prefix = self.batch.slice((slice(None), slice(0, L - 1)))
+        prefix = self.batch.slice((slice(None), slice(0, L - n_decode)))
         out1 = self.encoder.apply(
             self.params,
             prefix,
@@ -168,46 +173,49 @@ class TestNAEncoder:
         past = out1.past_key_values
         np.testing.assert_allclose(
             np.asarray(out1.last_hidden_state),
-            np.asarray(full.last_hidden_state[:, : L - 1]),
+            np.asarray(full.last_hidden_state[:, : L - n_decode]),
             rtol=1e-4,
             atol=1e-5,
         )
 
         t_full = time_from_deltas(self.batch)
-        trimmed = self.batch.slice((slice(None), slice(L - 1, L))).replace(
-            time=t_full[:, L - 1 : L]
-        )
+        for ev in range(L - n_decode, L):
+            trimmed = self.batch.slice((slice(None), slice(ev, ev + 1))).replace(
+                time=t_full[:, ev : ev + 1]
+            )
 
-        for target in range(1, G):
-            out_t = self.encoder.apply(
+            for target in range(1, G):
+                out_t = self.encoder.apply(
+                    self.params,
+                    trimmed,
+                    past=past,
+                    use_cache=True,
+                    dep_graph_el_generation_target=target,
+                )
+                past = out_t.past_key_values
+                np.testing.assert_allclose(
+                    np.asarray(out_t.last_hidden_state[:, 0, 0]),
+                    np.asarray(full.last_hidden_state[:, ev, target - 1]),
+                    rtol=1e-4,
+                    atol=1e-5,
+                    err_msg=f"event={ev} target={target}",
+                )
+
+            out_0 = self.encoder.apply(
                 self.params,
                 trimmed,
                 past=past,
                 use_cache=True,
-                dep_graph_el_generation_target=target,
+                dep_graph_el_generation_target=0,
             )
-            past = out_t.past_key_values
+            past = out_0.past_key_values
             np.testing.assert_allclose(
-                np.asarray(out_t.last_hidden_state[:, 0, 0]),
-                np.asarray(full.last_hidden_state[:, L - 1, target - 1]),
+                np.asarray(out_0.last_hidden_state[:, 0, 0]),
+                np.asarray(full.last_hidden_state[:, ev, G - 1]),
                 rtol=1e-4,
                 atol=1e-5,
-                err_msg=f"target={target}",
+                err_msg=f"event={ev} target=0",
             )
-
-        out_0 = self.encoder.apply(
-            self.params,
-            trimmed,
-            past=past,
-            use_cache=True,
-            dep_graph_el_generation_target=0,
-        )
-        np.testing.assert_allclose(
-            np.asarray(out_0.last_hidden_state[:, 0, 0]),
-            np.asarray(full.last_hidden_state[:, L - 1, G - 1]),
-            rtol=1e-4,
-            atol=1e-5,
-        )
 
 
 class TestNAModel:

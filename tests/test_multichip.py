@@ -17,6 +17,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from __graft_entry__ import _make_model_and_batch
 
 
+def shard_inputs(batch, params, *extra_replicated):
+    """Distribute a batch over the data axis of an 8-device mesh; replicate
+    params (and any extra pytrees, e.g. optimizer state)."""
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    replicated = NamedSharding(mesh, P())
+    batch_sh = jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+        ),
+        batch,
+    )
+    params_sh = jax.device_put(params, replicated)
+    extras = tuple(jax.device_put(e, replicated) for e in extra_replicated)
+    return (batch_sh, params_sh) + extras
+
+
 @pytest.fixture(scope="module")
 def model_batch_params():
     model, batch = _make_model_and_batch(
@@ -42,15 +58,7 @@ def test_sharded_loss_and_grads_match_unsharded(model_batch_params):
     loss_ref, grads_ref = grad_fn(params, batch)
 
     # Sharded run: batch split over the data axis, params replicated.
-    mesh = Mesh(np.asarray(jax.devices()), ("data",))
-    replicated = NamedSharding(mesh, P())
-    batch_sh = jax.tree_util.tree_map(
-        lambda x: jax.device_put(
-            x, NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
-        ),
-        batch,
-    )
-    params_sh = jax.device_put(params, replicated)
+    batch_sh, params_sh = shard_inputs(batch, params)
 
     # The input really is distributed over all 8 devices before the run.
     assert len(batch_sh.dynamic_indices.sharding.device_set) == 8
@@ -83,16 +91,7 @@ def test_sharded_train_step_updates_match(model_batch_params):
     opt_state = tx.init(params)
     p_ref, _, loss_ref = step(params, opt_state, batch)
 
-    mesh = Mesh(np.asarray(jax.devices()), ("data",))
-    replicated = NamedSharding(mesh, P())
-    batch_sh = jax.tree_util.tree_map(
-        lambda x: jax.device_put(
-            x, NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
-        ),
-        batch,
-    )
-    params_sh = jax.device_put(params, replicated)
-    opt_state_sh = jax.device_put(tx.init(params), replicated)
+    batch_sh, params_sh, opt_state_sh = shard_inputs(batch, params, tx.init(params))
 
     p_sh, _, loss_sh = step(params_sh, opt_state_sh, batch_sh)
 
