@@ -655,9 +655,12 @@ class JaxDataset(SeedableMixin, TimeableMixin):
 
         The batch shape is always static. With ``drop_last=False`` (the
         default when ``shuffle=False``, i.e. eval), a final short batch is
-        filled by wrapping around to the epoch's first subjects; with
-        ``drop_last=True`` (default when shuffling, i.e. training) the
-        remainder is dropped.
+        filled by cyclically repeating the epoch's first subjects — but every
+        fill row is **blanked** (``event_mask`` and ``dynamic_values_mask``
+        all False) and marked invalid in ``batch.valid_mask`` so eval loops
+        never double-count subjects: weight per-subject metrics (incl.
+        ``stream_labels``) by ``valid_mask``. With ``drop_last=True``
+        (default when shuffling, i.e. training) the remainder is dropped.
         """
         n = len(self)
         if drop_last is None:
@@ -667,7 +670,22 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         stop = n - (n % batch_size) if drop_last else n
         for lo in range(0, stop, batch_size):
             idx = order[lo : lo + batch_size]
-            if len(idx) < batch_size:
-                fill = order[: batch_size - len(idx)]
+            n_real = len(idx)
+            if n_real < batch_size:
+                # np.resize repeats cyclically, so this stays full even when
+                # batch_size exceeds the dataset size.
+                fill = np.resize(order, batch_size - n_real)
                 idx = np.concatenate([idx, fill])
-            yield self.collate_indices(idx, rng=rng)
+            b = self.collate_indices(idx, rng=rng)
+            valid = np.arange(batch_size) < n_real
+            if n_real < batch_size:
+                event_mask = np.asarray(b.event_mask).copy()
+                event_mask[n_real:] = False
+                values_mask = np.asarray(b.dynamic_values_mask).copy()
+                values_mask[n_real:] = False
+                b = b.replace(
+                    event_mask=event_mask, dynamic_values_mask=values_mask, valid_mask=valid
+                )
+            else:
+                b = b.replace(valid_mask=valid)
+            yield b

@@ -116,6 +116,9 @@ class EventStreamBatch:
     * ``start_time``: float ``(B,)`` minutes since epoch (generation only).
     * ``start_idx`` / ``end_idx`` / ``subject_id``: int ``(B,)`` (optional).
     * ``stream_labels``: dict of per-task label arrays ``(B,)`` (optional).
+    * ``valid_mask``: bool ``(B,)`` — False for wrap-around fill rows in the
+      final short eval batch (optional; absent means all rows valid). Eval
+      loops must weight per-subject metrics (incl. ``stream_labels``) by it.
     """
 
     event_mask: Optional[Array] = None
@@ -136,6 +139,8 @@ class EventStreamBatch:
     subject_id: Optional[Array] = None
 
     stream_labels: Optional[dict[str, Array]] = None
+
+    valid_mask: Optional[Array] = None
 
     # -- dict-like conveniences matching the reference API ------------------
     def keys(self):
@@ -199,6 +204,7 @@ class EventStreamBatch:
             stream_labels=(
                 None if self.stream_labels is None else {k: v[b] for k, v in self.stream_labels.items()}
             ),
+            valid_mask=_b(self.valid_mask),
         )
 
     def last_sequence_element_unsqueezed(self) -> "EventStreamBatch":

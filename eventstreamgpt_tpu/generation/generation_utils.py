@@ -32,8 +32,24 @@ from ..data.types import EventStreamBatch
 from ..models.config import StructuredEventProcessingMode, StructuredTransformerConfig
 from ..models.transformer import NAPast, init_kv_caches, time_from_deltas
 from .sampling import append_new_event, sample_predictions, update_last_event_data
+from .stopping_criteria import StoppingCriteriaList
 
 Array = Any
+
+
+@jax.jit
+def _batch_nonfinite(batch: EventStreamBatch) -> Array:
+    """True if any float tensor in the batch holds a NaN/inf (scalar bool).
+
+    The reference validates every batch tensor between generation steps
+    (``generation_utils.py:253-269``); here the checks are fused into one
+    jitted reduction so the guard costs one scalar readback per step.
+    """
+    bad = jnp.asarray(False)
+    for x in (batch.time_delta, batch.dynamic_values):
+        if x is not None:
+            bad = bad | ~jnp.isfinite(x).all()
+    return bad
 
 
 def _preallocate(batch: EventStreamBatch, max_new_events: int) -> EventStreamBatch:
@@ -112,6 +128,8 @@ def generate(
     max_length: int | None = None,
     num_return_sequences: int = 1,
     use_cache: bool = True,
+    stopping_criteria: StoppingCriteriaList | None = None,
+    do_validate_batch: bool = True,
 ) -> EventStreamBatch:
     """Autoregressively samples future events (reference ``generate`` ``:124``).
 
@@ -131,12 +149,40 @@ def generate(
             expanded in-order (reference ``:216``).
         use_cache: Use KV caches (one forward per new event/element) instead
             of full forwards each step.
+        stopping_criteria: Optional `StoppingCriteriaList` consulted before
+            the loop and after every completed event (reference ``:239,297``);
+            a `MaxLengthCriteria` inside it also bounds ``max_new_events``. A
+            criterion already satisfied by the prompt returns the prompt
+            (expanded by ``num_return_sequences``) unchanged.
+        do_validate_batch: Check the prompt for NaN/inf before generating and
+            raise (reference ``:253-269`` checks every step; here every value
+            *written* during generation is already sanitized at the sampling
+            layer — ``sampling.py`` ``nan_to_num``/clamps — so only the
+            prompt can carry non-finites and one up-front check suffices,
+            avoiding a per-event device sync).
 
     Returns:
         The completed `EventStreamBatch` of ``input_len + max_new_events``
-        events.
+        events (fewer if a stopping criterion fired).
     """
     input_len = batch.sequence_length
+    if num_return_sequences > 1:
+        batch = batch.repeat_batch_elements(num_return_sequences)
+
+    if do_validate_batch and bool(_batch_nonfinite(batch)):
+        raise ValueError(
+            "Non-finite values (NaN/inf) in the prompt batch; generation would "
+            "propagate them. Clean the inputs or pass do_validate_batch=False."
+        )
+
+    if stopping_criteria is not None:
+        if bool(stopping_criteria(batch, n_events=input_len)):
+            return batch
+        if stopping_criteria.max_length is not None:
+            crit_new = stopping_criteria.max_length - input_len
+            max_new_events = (
+                crit_new if max_new_events is None else min(max_new_events, crit_new)
+            )
     if max_new_events is None:
         if max_length is None:
             max_length = config.max_seq_len
@@ -144,17 +190,44 @@ def generate(
     if max_new_events <= 0:
         raise ValueError(f"max_new_events must be positive; got {max_new_events}")
 
-    if num_return_sequences > 1:
-        batch = batch.repeat_batch_elements(num_return_sequences)
-
     mode = config.structured_event_processing_mode
-    if mode == StructuredEventProcessingMode.CONDITIONALLY_INDEPENDENT:
-        return _generate_ci(model, params, batch, config, key, max_new_events, use_cache)
-    return _generate_na(model, params, batch, config, key, max_new_events, use_cache)
+    gen = (
+        _generate_ci
+        if mode == StructuredEventProcessingMode.CONDITIONALLY_INDEPENDENT
+        else _generate_na
+    )
+    return gen(
+        model,
+        params,
+        batch,
+        config,
+        key,
+        max_new_events,
+        use_cache,
+        stopping_criteria=stopping_criteria,
+    )
+
+
+def _should_stop(big, cursor, stopping_criteria) -> bool:
+    """Consults stopping criteria after a completed event (reference
+    ``generation_utils.py:239,297``). Returns True if generation should stop."""
+    if stopping_criteria is None:
+        return False
+    masked = _mask_through_cursor(big, cursor)
+    return bool(stopping_criteria(masked, n_events=int(cursor)))
 
 
 # ------------------------------------------------------------------- CI path
-def _generate_ci(model, params, batch, config, key, max_new_events, use_cache):
+def _generate_ci(
+    model,
+    params,
+    batch,
+    config,
+    key,
+    max_new_events,
+    use_cache,
+    stopping_criteria=None,
+):
     B = batch.batch_size
     input_len = batch.sequence_length
     total_len = input_len + max_new_events
@@ -210,12 +283,23 @@ def _generate_ci(model, params, batch, config, key, max_new_events, use_cache):
             preds_last = _slice_preds_at(preds, cursor - 1)
         big = sample_and_write(params, big, preds_last, cursor, step_key)
         cursor = cursor + 1
+        if _should_stop(big, cursor, stopping_criteria):
+            break
 
     return _mask_through_cursor(big, cursor)
 
 
 # ------------------------------------------------------------------- NA path
-def _generate_na(model, params, batch, config, key, max_new_events, use_cache):
+def _generate_na(
+    model,
+    params,
+    batch,
+    config,
+    key,
+    max_new_events,
+    use_cache,
+    stopping_criteria=None,
+):
     B = batch.batch_size
     input_len = batch.sequence_length
     total_len = input_len + max_new_events
@@ -318,5 +402,7 @@ def _generate_na(model, params, batch, config, key, max_new_events, use_cache):
             else:
                 big = do_fills[level](params, big, preds_last, cursor + 1, step_key)
         cursor = cursor + 1
+        if _should_stop(big, cursor, stopping_criteria):
+            break
 
     return _mask_through_cursor(big, cursor)

@@ -567,9 +567,13 @@ class OptimizationConfig(JSONableMixin):
             self.lr_num_warmup_steps = int(round(self.lr_frac_warmup_steps * self.max_training_steps))
         elif self.lr_frac_warmup_steps is None:
             self.lr_frac_warmup_steps = self.lr_num_warmup_steps / self.max_training_steps
+        # Unlike the reference (``transformer/config.py:303-305``, where an
+        # operator-precedence slip makes the check unreachable), this really
+        # validates that warmup fraction and step count agree.
         if not (
             math.floor(self.lr_frac_warmup_steps * self.max_training_steps) <= self.lr_num_warmup_steps
-        ) and (math.ceil(self.lr_frac_warmup_steps * self.max_training_steps) >= self.lr_num_warmup_steps):
+            <= math.ceil(self.lr_frac_warmup_steps * self.max_training_steps)
+        ):
             raise ValueError(
                 "`self.lr_frac_warmup_steps`, `self.max_training_steps`, and `self.lr_num_warmup_steps` "
                 "should be consistent, but they aren't! Got\n"

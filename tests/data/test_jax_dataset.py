@@ -154,6 +154,24 @@ class TestCollation:
         for b in batches:
             assert b.event_mask.shape == (2, 16)
 
+    def test_batches_final_fill_rows_are_blanked(self, sample_dir):
+        """Wrap-around fill rows in the final short batch carry no real
+        events, so eval loops never double-count subjects."""
+        cfg = make_config(sample_dir, max_seq_len=16)
+        ds = JaxDataset(cfg, "tuning")
+        n = len(ds)
+        bs = n - 1 if n > 2 else 2
+        n_fill = bs - (n % bs) if n % bs else 0
+        if n_fill == 0:
+            pytest.skip("dataset size divides batch size; no fill to test")
+        last = list(ds.batches(batch_size=bs, shuffle=False))[-1]
+        em = np.asarray(last.event_mask)
+        vm = np.asarray(last.dynamic_values_mask)
+        n_real = bs - n_fill
+        assert em[:n_real].any(axis=1).all()  # real rows have real events
+        assert not em[n_real:].any()  # fill rows fully masked
+        assert not vm[n_real:].any()
+
     def test_start_time_and_subject_id(self, sample_dir):
         cfg = make_config(
             sample_dir,

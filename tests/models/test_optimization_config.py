@@ -1,0 +1,49 @@
+"""Tests for `OptimizationConfig` (reference ``transformer/config.py:209-311``)."""
+
+import pytest
+
+from eventstreamgpt_tpu.models.config import OptimizationConfig
+
+
+class FakeDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+
+class TestOptimizationConfig:
+    def test_end_lr_derived(self):
+        cfg = OptimizationConfig(init_lr=1e-2, end_lr_frac_of_init_lr=1e-3)
+        assert cfg.end_lr == pytest.approx(1e-5)
+
+    def test_end_lr_mismatch_raises(self):
+        with pytest.raises(ValueError, match="must be equal"):
+            OptimizationConfig(init_lr=1e-2, end_lr=5e-4, end_lr_frac_of_init_lr=1e-3)
+
+    def test_set_to_dataset_derives_steps(self):
+        cfg = OptimizationConfig(batch_size=4, max_epochs=2, lr_frac_warmup_steps=0.1)
+        cfg.set_to_dataset(FakeDataset(40))
+        assert cfg.max_training_steps == 20
+        assert cfg.lr_num_warmup_steps == 2
+
+    def test_inconsistent_warmup_raises(self):
+        """The warmup-consistency guard really fires (the reference's version
+        is unreachable due to an operator-precedence slip,
+        ``transformer/config.py:303-305``)."""
+        cfg = OptimizationConfig(
+            batch_size=4,
+            max_epochs=2,
+            lr_frac_warmup_steps=0.1,
+            lr_num_warmup_steps=15,  # inconsistent with 0.1 * 20 = 2
+        )
+        with pytest.raises(ValueError, match="consistent"):
+            cfg.set_to_dataset(FakeDataset(40))
+
+    def test_consistent_warmup_passes(self):
+        cfg = OptimizationConfig(
+            batch_size=4, max_epochs=2, lr_frac_warmup_steps=0.1, lr_num_warmup_steps=2
+        )
+        cfg.set_to_dataset(FakeDataset(40))
+        assert cfg.max_training_steps == 20

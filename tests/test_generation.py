@@ -270,3 +270,100 @@ class TestStoppingCriteria:
         crits = StoppingCriteriaList([MaxLengthCriteria(5)])
         assert crits.max_length == 5
         assert crits(make_prompt(L=3), n_events=7)
+
+    def test_generate_consumes_max_length_criteria(self):
+        """A MaxLengthCriteria inside generate() bounds the generated length."""
+        config = ci_config()
+        batch = make_prompt(L=3)
+        model = CIPPTForGenerativeSequenceModeling(config)
+        params = model.init(jax.random.PRNGKey(0), batch)
+        out = generate(
+            model,
+            params,
+            batch,
+            config,
+            jax.random.PRNGKey(1),
+            max_new_events=5,
+            stopping_criteria=StoppingCriteriaList([MaxLengthCriteria(5)]),
+        )
+        assert out.sequence_length == 5  # clamped from 3+5 to the criterion's 5
+
+    def test_generate_returns_prompt_when_criterion_already_met(self):
+        config = ci_config()
+        batch = make_prompt(L=3)
+        model = CIPPTForGenerativeSequenceModeling(config)
+        params = model.init(jax.random.PRNGKey(0), batch)
+        out = generate(
+            model,
+            params,
+            batch,
+            config,
+            jax.random.PRNGKey(1),
+            max_new_events=5,
+            stopping_criteria=StoppingCriteriaList([MaxLengthCriteria(3)]),
+        )
+        assert out.sequence_length == 3  # prompt returned unchanged
+
+    def test_generate_stops_on_custom_criterion(self):
+        """A criterion firing mid-loop halts generation; tail stays masked."""
+
+        from eventstreamgpt_tpu.generation.stopping_criteria import StoppingCriteria
+
+        class StopAfterThree(StoppingCriteria):
+            """Fires on its 3rd consultation: generate() checks once before
+            the loop and once per completed event, so this stops after two
+            generated events."""
+
+            def __init__(self):
+                self.calls = 0
+
+            def __call__(self, batch, **kwargs) -> bool:
+                self.calls += 1
+                return self.calls >= 3
+
+        config = ci_config()
+        batch = make_prompt(L=3)
+        model = CIPPTForGenerativeSequenceModeling(config)
+        params = model.init(jax.random.PRNGKey(0), batch)
+        out = generate(
+            model,
+            params,
+            batch,
+            config,
+            jax.random.PRNGKey(1),
+            max_new_events=5,
+            stopping_criteria=StoppingCriteriaList([StopAfterThree()]),
+        )
+        # Preallocated to 3+5 events, but only 2 were generated before stop.
+        em = np.asarray(out.event_mask)
+        np.testing.assert_array_equal(em.sum(axis=1), 5)
+        assert out.sequence_length == 8
+        assert not em[:, 5:].any()
+
+
+class TestNonFiniteGuard:
+    def test_nan_prompt_raises(self):
+        config = ci_config()
+        batch = make_prompt(L=3)
+        bad = batch.replace(time_delta=batch.time_delta.at[0, 1].set(jnp.nan))
+        model = CIPPTForGenerativeSequenceModeling(config)
+        params = model.init(jax.random.PRNGKey(0), batch)
+        with pytest.raises(ValueError, match="Non-finite"):
+            generate(model, params, bad, config, jax.random.PRNGKey(1), max_new_events=2)
+
+    def test_guard_can_be_disabled(self):
+        config = ci_config()
+        batch = make_prompt(L=3)
+        bad = batch.replace(time_delta=batch.time_delta.at[0, 1].set(jnp.nan))
+        model = CIPPTForGenerativeSequenceModeling(config)
+        params = model.init(jax.random.PRNGKey(0), batch)
+        out = generate(
+            model,
+            params,
+            bad,
+            config,
+            jax.random.PRNGKey(1),
+            max_new_events=2,
+            do_validate_batch=False,
+        )
+        assert out.sequence_length == 5
