@@ -32,7 +32,7 @@ from ..data.types import EventStreamBatch
 from ..models.config import StructuredEventProcessingMode, StructuredTransformerConfig
 from ..models.transformer import NAPast, init_kv_caches, time_from_deltas
 from .sampling import append_new_event, sample_predictions, update_last_event_data
-from .stopping_criteria import StoppingCriteriaList
+from .stopping_criteria import MaxLengthCriteria, StoppingCriteriaList
 
 Array = Any
 
@@ -175,20 +175,30 @@ def generate(
             "propagate them. Clean the inputs or pass do_validate_batch=False."
         )
 
+    bounds = []
     if stopping_criteria is not None:
         if bool(stopping_criteria(batch, n_events=input_len)):
             return batch
         if stopping_criteria.max_length is not None:
-            crit_new = stopping_criteria.max_length - input_len
-            max_new_events = (
-                crit_new if max_new_events is None else min(max_new_events, crit_new)
-            )
-    if max_new_events is None:
-        if max_length is None:
-            max_length = config.max_seq_len
-        max_new_events = max_length - input_len
+            bounds.append(stopping_criteria.max_length - input_len)
+    if max_new_events is not None:
+        bounds.append(max_new_events)
+    elif max_length is not None:
+        bounds.append(max_length - input_len)
+    elif not bounds:
+        bounds.append(config.max_seq_len - input_len)
+    # Every explicit bound applies; a MaxLengthCriteria cannot loosen an
+    # explicit max_length/max_new_events argument (or vice versa).
+    max_new_events = min(bounds)
     if max_new_events <= 0:
         raise ValueError(f"max_new_events must be positive; got {max_new_events}")
+
+    # Length bounds are fully folded into max_new_events above, so a criteria
+    # list containing only MaxLengthCriteria needs no per-event host sync.
+    if stopping_criteria is not None and all(
+        isinstance(c, MaxLengthCriteria) for c in stopping_criteria
+    ):
+        stopping_criteria = None
 
     mode = config.structured_event_processing_mode
     gen = (

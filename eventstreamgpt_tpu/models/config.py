@@ -12,6 +12,7 @@ fields here.
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 import itertools
 import math
@@ -21,6 +22,122 @@ from ..data.config import MeasurementConfig
 from ..data.types import DataModality
 from ..utils import JSONableMixin, StrEnum, config_dataclass
 from .embedding import MEAS_INDEX_GROUP_T, MeasIndexGroupOptions, StaticEmbeddingMode
+
+
+class Split(StrEnum):
+    """What data split is being used (reference ``config.py:25``)."""
+
+    TRAIN = enum.auto()
+    TUNING = enum.auto()
+    HELD_OUT = enum.auto()
+
+
+class MetricCategories(StrEnum):
+    """Categories of metrics, for configuring what to track (reference ``config.py:44``)."""
+
+    LOSS_PARTS = enum.auto()
+    TTE = "TTE"
+    CLASSIFICATION = enum.auto()
+    REGRESSION = enum.auto()
+
+
+class Metrics(StrEnum):
+    """Supported metric functions (reference ``config.py:63``)."""
+
+    AUROC = "AUROC"
+    AUPRC = "AUPRC"
+    ACCURACY = enum.auto()
+    EXPLAINED_VARIANCE = enum.auto()
+    MSE = "MSE"
+    MSLE = "MSLE"
+
+
+class Averaging(StrEnum):
+    """Metric averaging modes in multi-class/multi-label settings (reference ``config.py:91``)."""
+
+    MACRO = enum.auto()
+    MICRO = enum.auto()
+    WEIGHTED = enum.auto()
+
+
+def _default_include_metrics() -> dict:
+    # Built per split so the nested dicts are never aliased between splits.
+    def eval_metrics() -> dict:
+        return {
+            MetricCategories.LOSS_PARTS: True,
+            MetricCategories.TTE: {Metrics.MSE: True, Metrics.MSLE: True},
+            MetricCategories.CLASSIFICATION: {
+                Metrics.AUROC: [Averaging.WEIGHTED],
+                Metrics.ACCURACY: True,
+            },
+            MetricCategories.REGRESSION: {Metrics.MSE: True},
+        }
+
+    return {Split.TUNING: eval_metrics(), Split.HELD_OUT: eval_metrics()}
+
+
+@config_dataclass
+class MetricsConfig(JSONableMixin):
+    """What metrics should be tracked, over which splits, with which averagings.
+
+    Reference: ``transformer/config.py:104-206`` (``MetricsConfig``). The
+    ``include_metrics`` format is ``{split: {category: True | {metric: True |
+    [averagings]}}}``; ``do_skip_all_metrics`` clears it entirely.
+    """
+
+    n_auc_thresholds: int | None = 50
+    do_skip_all_metrics: bool = False
+    do_validate_args: bool = False
+    include_metrics: dict[str, Any] = dataclasses.field(default_factory=_default_include_metrics)
+
+    def __post_init__(self):
+        if self.do_skip_all_metrics:
+            self.include_metrics = {}
+
+    def do_log_only_loss(self, split: str) -> bool:
+        """True if only the loss (no other metrics) should be logged for ``split``."""
+        if (
+            self.do_skip_all_metrics
+            or split not in self.include_metrics
+            or not self.include_metrics[split]
+            or (
+                len(self.include_metrics[split]) == 1
+                and MetricCategories.LOSS_PARTS in self.include_metrics[split]
+            )
+        ):
+            return True
+        return False
+
+    def do_log(self, split: str, cat: str, metric_name: str | None = None) -> bool:
+        """True if ``metric_name`` should be tracked for ``split`` and ``cat``.
+
+        Reference: ``transformer/config.py:176-199``. Metric names may carry an
+        averaging prefix (e.g. ``weighted_AUROC``); ``explained_variance`` is
+        the one un-prefixed metric containing an underscore.
+        """
+        if self.do_log_only_loss(split):
+            return False
+
+        inc_dict = self.include_metrics[split].get(cat, False)
+        if not inc_dict:
+            return False
+        if metric_name is None or inc_dict is True:
+            return True
+
+        has_averaging = "_" in metric_name.replace("explained_variance", "")
+        if not has_averaging:
+            return metric_name in inc_dict
+
+        parts = metric_name.split("_")
+        averaging = parts[0]
+        metric = "_".join(parts[1:])
+
+        permissible_averagings = inc_dict.get(metric, [])
+        return (permissible_averagings is True) or (averaging in permissible_averagings)
+
+    def do_log_any(self, cat: str, metric_name: str | None = None) -> bool:
+        """True if ``metric_name`` should be tracked for ``cat`` on any split."""
+        return any(self.do_log(split, cat, metric_name) for split in Split.values())
 
 
 class StructuredEventProcessingMode(StrEnum):

@@ -1,0 +1,40 @@
+"""Training harnesses: pretraining driver, optimizer, checkpointing, metrics.
+
+TPU-native replacement for the reference's Lightning modules
+(``/root/reference/EventStream/transformer/lightning_modules/``).
+"""
+
+from .checkpoint import TrainCheckpointManager, load_pretrained, save_pretrained
+from .generative_metrics import GenerativeMetrics
+from .optimizer import build_optimizer, polynomial_decay_with_warmup
+from .pretrain import (
+    PretrainConfig,
+    TrainState,
+    build_model,
+    data_parallel_mesh,
+    evaluate,
+    make_eval_step,
+    make_train_step,
+    replicate,
+    shard_batch,
+    train,
+)
+
+__all__ = [
+    "GenerativeMetrics",
+    "PretrainConfig",
+    "TrainCheckpointManager",
+    "TrainState",
+    "build_model",
+    "build_optimizer",
+    "data_parallel_mesh",
+    "evaluate",
+    "load_pretrained",
+    "make_eval_step",
+    "make_train_step",
+    "polynomial_decay_with_warmup",
+    "replicate",
+    "save_pretrained",
+    "shard_batch",
+    "train",
+]

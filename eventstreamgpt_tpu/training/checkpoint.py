@@ -1,0 +1,121 @@
+"""Model serialization: the ``save_pretrained`` directory contract + resume.
+
+The reference bootstraps every downstream stage (fine-tune, zero-shot,
+embeddings, trajectory generation) from a pretrain ``save_dir`` containing
+``config.json``, ``data_config.json``, ``optimization_config.json``, and HF
+``save_pretrained`` weights under ``pretrained_weights``
+(``/root/reference/EventStream/transformer/lightning_modules/generative_modeling.py:113-115,576-596,670``;
+``fine_tuning.py:329-372``). This module reproduces that contract with orbax
+as the array store, and adds what the reference lacks (SURVEY §5.3/§5.4):
+**step-level, preemption-safe resume checkpoints** via
+``orbax.CheckpointManager`` (atomic finalization, keeps the most recent K,
+restores latest on restart — what TPU-pod preemption requires).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+from ..models.config import StructuredTransformerConfig
+
+PRETRAINED_WEIGHTS_DIR = "pretrained_weights"
+
+
+def _abs(path: Path | str) -> Path:
+    return Path(path).expanduser().resolve()
+
+
+def save_pretrained(save_dir: Path | str, params: Any, config: StructuredTransformerConfig | None = None) -> Path:
+    """Writes model parameters (and optionally the config) under ``save_dir``.
+
+    Mirrors ``LM.save_pretrained`` + the rank-0 config dump: weights go to
+    ``save_dir/pretrained_weights``, config to ``save_dir/config.json`` (only
+    when given — the pretrain driver writes configs up front).
+    """
+    save_dir = _abs(save_dir)
+    save_dir.mkdir(parents=True, exist_ok=True)
+    weights_fp = save_dir / PRETRAINED_WEIGHTS_DIR
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(weights_fp, params, force=True)
+    if config is not None:
+        config.to_json_file(save_dir / "config.json", do_overwrite=True)
+    return weights_fp
+
+
+def load_pretrained(
+    save_dir: Path | str, params_template: Any | None = None
+) -> tuple[Any, StructuredTransformerConfig]:
+    """Loads ``(params, config)`` from a ``save_pretrained`` directory.
+
+    ``params_template`` (a pytree of like-shaped arrays, e.g. from
+    ``model.init``) restores with matching dtypes/structure; without it the
+    stored tree structure is returned as saved.
+    """
+    save_dir = _abs(save_dir)
+    config = StructuredTransformerConfig.from_json_file(save_dir / "config.json")
+    ckptr = ocp.PyTreeCheckpointer()
+    weights_fp = save_dir / PRETRAINED_WEIGHTS_DIR
+    if params_template is not None:
+        params = ckptr.restore(weights_fp, item=params_template)
+    else:
+        params = ckptr.restore(weights_fp)
+    return params, config
+
+
+class TrainCheckpointManager:
+    """Step-level train-state checkpointing with preemption-safe resume.
+
+    Wraps ``orbax.CheckpointManager``: atomic commits, ``max_to_keep`` most
+    recent steps retained, ``latest_step`` discovery for auto-resume. The
+    train state is whatever pytree the training loop passes (params +
+    opt_state + step + rng); scalars ride alongside as JSON metadata.
+    """
+
+    def __init__(self, ckpt_dir: Path | str, max_to_keep: int = 2, save_interval_steps: int = 1):
+        self.ckpt_dir = _abs(ckpt_dir)
+        self.ckpt_dir.mkdir(parents=True, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.ckpt_dir,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, metadata: dict | None = None) -> bool:
+        saved = self._mgr.save(step, args=ocp.args.PyTreeSave(state))
+        if saved and metadata is not None:
+            # Metadata rides next to the manager root; small, human-readable.
+            with open(self.ckpt_dir / f"metadata_{step}.json", "w") as f:
+                json.dump(metadata, f)
+        return saved
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, state_template: Any, step: int | None = None) -> tuple[Any, int]:
+        """Restores ``(state, step)`` at ``step`` (default: latest)."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"No checkpoints found under {self.ckpt_dir}")
+        state = self._mgr.restore(step, args=ocp.args.PyTreeRestore(state_template))
+        return state, step
+
+    def metadata(self, step: int) -> dict | None:
+        fp = self.ckpt_dir / f"metadata_{step}.json"
+        if fp.exists():
+            with open(fp) as f:
+                return json.load(f)
+        return None
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,284 @@
+"""Per-measurement generative metric collection, gated by ``MetricsConfig``.
+
+Rebuild of the reference Lightning module's metric zoo + logging
+(``/root/reference/EventStream/transformer/lightning_modules/generative_modeling.py:117-432``):
+``build_metrics`` instantiates one accumulator per measurement × modality ×
+metric × averaging that the config admits on any split; ``update`` consumes a
+``GenerativeSequenceModelOutput`` exactly the way ``log_metrics`` does
+(distribution sampling for TTE/regression, masked slicing, indexed-regression
+expansion); ``compute`` returns ``{split}_{measurement}_{metric}`` → value.
+
+Losses are tracked per subject: each component loss in this codebase is a
+macro-average over the batch's subject axis with zero contributions from
+blanked fill rows, so re-weighting the batch mean by ``batch_size /
+n_valid`` recovers the exact per-valid-subject average (this is how eval
+avoids double-counting wrap-around fill subjects; see
+``JaxDataset.batches``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..data.types import DataModality
+from ..models.config import (
+    Averaging,
+    MetricCategories,
+    Metrics,
+    MetricsConfig,
+    Split,
+    StructuredTransformerConfig,
+)
+from .metrics import (
+    ExplainedVariance,
+    MeanMetric,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAccuracy,
+    MultilabelAUROC,
+    MultilabelAveragePrecision,
+)
+
+CLASSIFICATION_MODALITIES = {
+    DataModality.SINGLE_LABEL_CLASSIFICATION,
+    DataModality.MULTI_LABEL_CLASSIFICATION,
+}
+
+
+def expand_indexed_regression_np(x: np.ndarray, idx: np.ndarray, vocab_size: int) -> np.ndarray:
+    """Scatter sparse per-key values into dense vocab space (host-side twin of
+    ``ops.tensor_ops.expand_indexed_regression``)."""
+    out = np.zeros((*x.shape[:-1], vocab_size), dtype=x.dtype)
+    np.put_along_axis(out, idx.astype(np.int64), x, axis=-1)
+    return out
+
+
+class GenerativeMetrics:
+    """Accumulates loss + quality metrics for one split of generative eval."""
+
+    def __init__(
+        self,
+        config: StructuredTransformerConfig,
+        metrics_config: MetricsConfig,
+        split: str = Split.TUNING,
+    ):
+        self.config = config
+        self.metrics_config = metrics_config
+        self.split = split
+
+        self.loss = MeanMetric()
+        self.loss_parts: dict[str, MeanMetric] = {}
+
+        n_thresh = metrics_config.n_auc_thresholds or 50
+
+        # TTE metrics (reference ``build_metrics`` :124-130).
+        self.tte_metrics: dict[str, Any] = {}
+        if metrics_config.do_log(split, MetricCategories.TTE):
+            for name, m in (
+                ("MSE", MeanSquaredError),
+                ("MSLE", MeanSquaredLogError),
+                ("explained_variance", ExplainedVariance),
+            ):
+                if metrics_config.do_log(split, MetricCategories.TTE, name):
+                    self.tte_metrics[name] = m()
+
+        # Per-measurement zoo (reference :132-228).
+        self.metrics: dict[str, dict[str, dict[str, Any]]] = {}
+        for task_type, measurements in config.measurements_per_generative_mode.items():
+            for measurement in measurements:
+                vocab_size = config.vocab_sizes_by_measurement.get(measurement, 1)
+                per_meas = self.metrics.setdefault(measurement, {}).setdefault(task_type, {})
+
+                if task_type == DataModality.SINGLE_LABEL_CLASSIFICATION:
+                    cat = MetricCategories.CLASSIFICATION
+                    zoo = {
+                        Metrics.ACCURACY: (
+                            lambda avg: MulticlassAccuracy(vocab_size, average=avg, ignore_index=0),
+                            [Averaging.MACRO, Averaging.WEIGHTED, Averaging.MICRO],
+                        ),
+                        Metrics.AUROC: (
+                            lambda avg: MulticlassAUROC(
+                                vocab_size, thresholds=n_thresh, average=avg, ignore_index=0
+                            ),
+                            [Averaging.MACRO, Averaging.WEIGHTED],
+                        ),
+                        Metrics.AUPRC: (
+                            lambda avg: MulticlassAveragePrecision(
+                                vocab_size, thresholds=n_thresh, average=avg, ignore_index=0
+                            ),
+                            [Averaging.MACRO, Averaging.WEIGHTED],
+                        ),
+                    }
+                elif task_type == DataModality.MULTI_LABEL_CLASSIFICATION:
+                    cat = MetricCategories.CLASSIFICATION
+                    zoo = {
+                        Metrics.ACCURACY: (
+                            lambda avg: MultilabelAccuracy(vocab_size, average=avg),
+                            [Averaging.MACRO, Averaging.WEIGHTED, Averaging.MICRO],
+                        ),
+                        Metrics.AUROC: (
+                            lambda avg: MultilabelAUROC(vocab_size, thresholds=n_thresh, average=avg),
+                            [Averaging.MACRO, Averaging.WEIGHTED, Averaging.MICRO],
+                        ),
+                        Metrics.AUPRC: (
+                            lambda avg: MultilabelAveragePrecision(
+                                vocab_size, thresholds=n_thresh, average=avg
+                            ),
+                            [Averaging.MACRO, Averaging.WEIGHTED, Averaging.MICRO],
+                        ),
+                    }
+                elif task_type == DataModality.UNIVARIATE_REGRESSION:
+                    cat = MetricCategories.REGRESSION
+                    zoo = {
+                        Metrics.MSE: (lambda avg: MeanSquaredError(), [None]),
+                        Metrics.EXPLAINED_VARIANCE: (lambda avg: ExplainedVariance(), [None]),
+                    }
+                elif task_type == DataModality.MULTIVARIATE_REGRESSION:
+                    cat = MetricCategories.REGRESSION
+                    zoo = {
+                        Metrics.MSE: (lambda avg: MeanSquaredError(), [None]),
+                        Metrics.EXPLAINED_VARIANCE: (
+                            lambda avg: ExplainedVariance(
+                                multioutput="uniform_average"
+                                if avg == Averaging.MACRO
+                                else "variance_weighted"
+                            ),
+                            [Averaging.MACRO, Averaging.WEIGHTED],
+                        ),
+                    }
+                else:
+                    raise ValueError(f"Unrecognized modality {task_type}!")
+
+                for metric, (factory, averagings) in zoo.items():
+                    for averaging in averagings:
+                        metric_name = str(metric) if averaging is None else f"{averaging}_{metric}"
+                        if metrics_config.do_log(split, cat, metric_name):
+                            per_meas[metric_name] = factory(averaging)
+
+    # ------------------------------------------------------------------ update
+    def update(self, out, key: jax.Array | None = None, n_valid: int | None = None) -> None:
+        """Accumulates one batch's ``GenerativeSequenceModelOutput``.
+
+        ``n_valid`` is the count of non-fill subjects (``valid_mask.sum()``);
+        ``key`` drives distribution sampling for TTE/regression metrics and is
+        only needed when those categories are enabled.
+        """
+        mc = self.metrics_config
+        split = self.split
+
+        event_mask = np.asarray(out.event_mask)
+        B = event_mask.shape[0]
+        if n_valid is None:
+            n_valid = B
+
+        # Loss (+ parts). Denominator semantics differ per part: cls/reg parts
+        # go through ``weighted_loss`` (mean over *non-empty* subjects — fill
+        # rows are excluded already, no rescale), while the TTE part averages
+        # over all B subjects (``TTE_LL_per_patient.mean()``) with zero
+        # contribution from fill rows → rescale by B/n_valid. The total is
+        # reconstructed from the parts on short batches so each term gets its
+        # own correction.
+        tte_scale = B / max(n_valid, 1)
+        parts: dict[str, float] = {}
+        if out.losses is not None:
+            if out.losses.classification:
+                parts.update(
+                    {f"{k}_cls_NLL": float(v) for k, v in out.losses.classification.items()}
+                )
+            if out.losses.regression:
+                parts.update(
+                    {f"{k}_reg_NLL": float(v) for k, v in out.losses.regression.items()}
+                )
+            if out.losses.time_to_event is not None:
+                parts["TTE_reg_NLL"] = float(out.losses.time_to_event) * tte_scale
+        if out.loss is not None:
+            if n_valid == B or not parts:
+                loss_val = float(out.loss)
+            else:
+                loss_val = sum(parts.values())
+            self.loss.update(loss_val, weight=n_valid)
+        if mc.do_log(split, MetricCategories.LOSS_PARTS):
+            for name, v in parts.items():
+                acc = self.loss_parts.setdefault(name, MeanMetric())
+                acc.update(v, weight=n_valid)
+
+        if mc.do_log_only_loss(split):
+            return
+
+        # TTE metrics (reference ``log_tte_metrics`` :279-305): sample the
+        # distribution, keep interior intra-event times whose next event is
+        # observed.
+        if self.tte_metrics and out.preds is not None and out.preds.time_to_event is not None:
+            key, sub = jax.random.split(key)
+            tte_preds = np.asarray(out.preds.time_to_event.sample(sub))
+            sel = event_mask[:, 1:]
+            tte_preds = tte_preds[:, :-1][sel]
+            tte_labels = np.asarray(out.labels.time_to_event)[sel]
+            for acc in self.tte_metrics.values():
+                acc.update(tte_preds, tte_labels)
+
+        values_mask = np.asarray(out.dynamic_values_mask) if out.dynamic_values_mask is not None else None
+
+        for measurement, by_task in self.metrics.items():
+            mask = event_mask
+            if not mask.any():
+                continue
+            for task_type, metric_dict in by_task.items():
+                if not metric_dict:
+                    continue
+                if task_type in CLASSIFICATION_MODALITIES:
+                    # preds = logits of the sample distribution at observed events.
+                    _, sample_dist = out.preds.classification[measurement]
+                    preds = np.asarray(sample_dist.logits)[mask]
+                    labels = np.asarray(out.labels.classification[measurement])[mask]
+                    for acc in metric_dict.values():
+                        acc.update(preds, labels.astype(np.int64) if labels.ndim == 1 else labels)
+                elif task_type == DataModality.MULTIVARIATE_REGRESSION:
+                    vocab_size = self.config.vocab_sizes_by_measurement[measurement]
+                    _, dist = out.preds.regression[measurement]
+                    key, sub = jax.random.split(key)
+                    preds = np.asarray(dist.sample(sub))[mask]
+                    labels = np.asarray(out.labels.regression[measurement])[mask]
+                    preds_indices = np.asarray(out.preds.regression_indices[measurement])[mask]
+                    labels_indices = np.asarray(out.labels.regression_indices[measurement])[mask]
+                    data_el_mask = values_mask[mask]
+                    preds = preds[data_el_mask]
+                    labels = labels[data_el_mask]
+                    preds_indices = preds_indices[data_el_mask]
+                    labels_indices = labels_indices[data_el_mask]
+                    preds_expanded = expand_indexed_regression_np(
+                        preds[..., None], preds_indices[..., None], vocab_size
+                    )
+                    labels_expanded = expand_indexed_regression_np(
+                        labels[..., None], labels_indices[..., None], vocab_size
+                    )
+                    for acc in metric_dict.values():
+                        acc.update(preds_expanded, labels_expanded)
+                elif task_type == DataModality.UNIVARIATE_REGRESSION:
+                    _, dist = out.preds.regression[measurement]
+                    key, sub = jax.random.split(key)
+                    preds = np.asarray(dist.sample(sub))[mask]
+                    labels = np.asarray(out.labels.regression[measurement])[mask]
+                    for acc in metric_dict.values():
+                        acc.update(preds, labels)
+
+    # ----------------------------------------------------------------- compute
+    def compute(self) -> dict[str, float]:
+        """Returns ``{split}_...``-named metric values, NaNs dropped."""
+        split = self.split
+        result = {f"{split}_loss": self.loss.compute()}
+        for name, acc in self.loss_parts.items():
+            result[f"{split}_{name}"] = acc.compute()
+        for name, acc in self.tte_metrics.items():
+            result[f"{split}_TTE_{name}"] = acc.compute()
+        for measurement, by_task in self.metrics.items():
+            for metric_dict in by_task.values():
+                for metric_name, acc in metric_dict.items():
+                    result[f"{split}_{measurement}_{metric_name}"] = acc.compute()
+        return {k: v for k, v in result.items() if not (isinstance(v, float) and np.isnan(v))}

@@ -1,0 +1,367 @@
+"""Streaming metric accumulators + the per-measurement generative metric zoo.
+
+TPU-native replacement for the reference's ``torchmetrics`` usage
+(``/root/reference/EventStream/transformer/lightning_modules/generative_modeling.py:117-432``).
+Device code produces model outputs; metric state lives on host as plain numpy
+(eval metric accumulation is not the hot path), with AUROC/AUPRC computed on a
+fixed threshold grid (``MetricsConfig.n_auc_thresholds``) exactly like the
+reference's binned ``torchmetrics`` configuration, so memory stays bounded at
+MIMIC scale.
+
+Averaging semantics follow ``torchmetrics``:
+
+* multiclass accuracy: per-class recall; ``macro`` averages classes with
+  support, ``micro``/``weighted`` collapse to overall correct/N.
+* multilabel accuracy: per-label binary accuracy at a 0.5 threshold.
+* AUROC: trapezoidal area over the binned (FPR, TPR) curve.
+* AUPRC / average precision: step-interpolated sum over the binned PR curve.
+* explained variance: ``1 - Var[y - yhat]/Var[y]``, per output dim, combined
+  by ``uniform_average`` or ``variance_weighted``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "MeanMetric",
+    "MulticlassAccuracy",
+    "MultilabelAccuracy",
+    "MulticlassAUROC",
+    "MultilabelAUROC",
+    "MulticlassAveragePrecision",
+    "MultilabelAveragePrecision",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "ExplainedVariance",
+]
+
+
+class MeanMetric:
+    """Weighted running mean (the ``self.log`` aggregation in the reference)."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.weight = 0.0
+
+    def update(self, value: float, weight: float = 1.0) -> None:
+        if not np.isfinite(value):
+            return
+        self.total += float(value) * float(weight)
+        self.weight += float(weight)
+
+    def compute(self) -> float:
+        return self.total / self.weight if self.weight > 0 else float("nan")
+
+
+def _as_probs_multiclass(preds: np.ndarray) -> np.ndarray:
+    """Logits → probabilities if needed (torchmetrics auto-detection)."""
+    if preds.size and (preds.min() < 0 or preds.max() > 1):
+        z = preds - preds.max(axis=-1, keepdims=True)
+        e = np.exp(z)
+        return e / e.sum(axis=-1, keepdims=True)
+    return preds
+
+
+def _as_probs_binary(preds: np.ndarray) -> np.ndarray:
+    if preds.size and (preds.min() < 0 or preds.max() > 1):
+        return 1.0 / (1.0 + np.exp(-preds))
+    return preds
+
+
+class MulticlassAccuracy:
+    """Multiclass accuracy over ``(N, C)`` preds and ``(N,)`` int labels.
+
+    ``macro`` = mean per-class recall over classes with support; ``micro`` and
+    ``weighted`` = overall fraction correct (they coincide for accuracy).
+    """
+
+    def __init__(self, num_classes: int, average: str = "micro", ignore_index: int | None = None):
+        self.num_classes = num_classes
+        self.average = average
+        self.ignore_index = ignore_index
+        self.correct = np.zeros(num_classes, dtype=np.int64)
+        self.support = np.zeros(num_classes, dtype=np.int64)
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        if preds.ndim == labels.ndim + 1:
+            preds = preds.reshape(-1, preds.shape[-1]).argmax(axis=-1)
+        else:
+            preds = preds.reshape(-1)
+        if self.ignore_index is not None:
+            keep = labels != self.ignore_index
+            preds, labels = preds[keep], labels[keep]
+        if labels.size == 0:
+            return
+        self.support += np.bincount(labels, minlength=self.num_classes)
+        hits = labels[preds == labels]
+        self.correct += np.bincount(hits, minlength=self.num_classes)
+
+    def compute(self) -> float:
+        if self.average == "macro":
+            has = self.support > 0
+            if not has.any():
+                return float("nan")
+            return float((self.correct[has] / self.support[has]).mean())
+        total = self.support.sum()
+        return float(self.correct.sum() / total) if total else float("nan")
+
+
+class MultilabelAccuracy:
+    """Multilabel accuracy over ``(N, L)`` preds (logits or probs) and 0/1 labels."""
+
+    def __init__(self, num_labels: int, average: str = "macro", threshold: float = 0.5):
+        self.num_labels = num_labels
+        self.average = average
+        self.threshold = threshold
+        self.correct = np.zeros(num_labels, dtype=np.int64)
+        self.count = np.zeros(num_labels, dtype=np.int64)
+        self.positives = np.zeros(num_labels, dtype=np.int64)
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        preds = _as_probs_binary(np.asarray(preds, dtype=np.float64)).reshape(-1, self.num_labels)
+        labels = np.asarray(labels).reshape(-1, self.num_labels) > 0.5
+        hard = preds >= self.threshold
+        self.correct += (hard == labels).sum(axis=0)
+        self.count += labels.shape[0]
+        self.positives += labels.sum(axis=0)
+
+    def compute(self) -> float:
+        if not self.count.any():
+            return float("nan")
+        per_label = self.correct / np.maximum(self.count, 1)
+        if self.average == "micro":
+            return float(self.correct.sum() / self.count.sum())
+        if self.average == "weighted":
+            w = self.positives.astype(np.float64)
+            if w.sum() == 0:
+                return float("nan")
+            return float((per_label * w).sum() / w.sum())
+        return float(per_label.mean())
+
+
+class _BinnedCurve:
+    """Shared thresholded confusion state for AUROC / average precision.
+
+    State per label/class: TP and FP counts at each threshold on a uniform
+    [0, 1] grid, plus positive/negative totals — the same bounded-memory
+    scheme ``torchmetrics`` uses when ``thresholds`` is an int.
+    """
+
+    def __init__(self, n_series: int, thresholds: int):
+        self.n_series = n_series
+        self.thresholds = np.linspace(0.0, 1.0, int(thresholds))
+        self.tp = np.zeros((n_series, len(self.thresholds)), dtype=np.int64)
+        self.fp = np.zeros((n_series, len(self.thresholds)), dtype=np.int64)
+        self.pos = np.zeros(n_series, dtype=np.int64)
+        self.neg = np.zeros(n_series, dtype=np.int64)
+
+    def _update_series(self, s: int, probs: np.ndarray, targets: np.ndarray) -> None:
+        """probs (M,), targets bool (M,)."""
+        above = probs[:, None] >= self.thresholds[None, :]
+        self.tp[s] += (above & targets[:, None]).sum(axis=0)
+        self.fp[s] += (above & ~targets[:, None]).sum(axis=0)
+        self.pos[s] += int(targets.sum())
+        self.neg[s] += int((~targets).sum())
+
+    def _auroc_series(self, s: int) -> float:
+        if self.pos[s] == 0 or self.neg[s] == 0:
+            return float("nan")
+        tpr = self.tp[s] / self.pos[s]
+        fpr = self.fp[s] / self.neg[s]
+        # Thresholds ascend → rates descend; integrate over increasing FPR.
+        order = np.argsort(fpr, kind="stable")
+        return float(np.trapezoid(tpr[order], fpr[order]))
+
+    def _ap_series(self, s: int) -> float:
+        if self.pos[s] == 0:
+            return float("nan")
+        recall = self.tp[s] / self.pos[s]
+        denom = self.tp[s] + self.fp[s]
+        precision = np.where(denom > 0, self.tp[s] / np.maximum(denom, 1), 1.0)
+        # Thresholds ascending → recall descending. AP = Σ (R_t − R_{t+1})·P_t
+        # with R after the last threshold pinned to 0.
+        r = np.concatenate([recall, [0.0]])
+        return float(np.sum((r[:-1] - r[1:]) * precision))
+
+    def _average(self, per_series: np.ndarray, average: str, micro_fn=None) -> float:
+        if average == "micro" and micro_fn is not None:
+            return micro_fn()
+        valid = ~np.isnan(per_series)
+        if not valid.any():
+            return float("nan")
+        if average == "weighted":
+            w = self.pos.astype(np.float64)
+            w[~valid] = 0.0
+            if w.sum() == 0:
+                return float("nan")
+            return float(np.nansum(per_series * w) / w.sum())
+        # macro (and micro fallback when no micro_fn is meaningful)
+        return float(per_series[valid].mean())
+
+
+class MulticlassAUROC(_BinnedCurve):
+    """One-vs-rest binned AUROC over ``(N, C)`` preds, ``(N,)`` int labels."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: int = 50,
+        average: str = "macro",
+        ignore_index: int | None = None,
+    ):
+        super().__init__(num_classes, thresholds)
+        self.average = average
+        self.ignore_index = ignore_index
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        preds = np.asarray(preds, dtype=np.float64).reshape(-1, self.n_series)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        if self.ignore_index is not None:
+            keep = labels != self.ignore_index
+            preds, labels = preds[keep], labels[keep]
+        if labels.size == 0:
+            return
+        probs = _as_probs_multiclass(preds)
+        for c in range(self.n_series):
+            self._update_series(c, probs[:, c], labels == c)
+
+    def compute(self) -> float:
+        per = np.array([self._auroc_series(c) for c in range(self.n_series)])
+        return self._average(per, self.average)
+
+
+class MultilabelAUROC(_BinnedCurve):
+    """Per-label binned AUROC over ``(N, L)`` preds and 0/1 labels."""
+
+    def __init__(self, num_labels: int, thresholds: int = 50, average: str = "macro"):
+        # One extra series accumulates the flattened micro curve.
+        super().__init__(num_labels + 1, thresholds)
+        self.num_labels = num_labels
+        self.average = average
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        preds = np.asarray(preds, dtype=np.float64).reshape(-1, self.num_labels)
+        labels = np.asarray(labels).reshape(-1, self.num_labels) > 0.5
+        probs = _as_probs_binary(preds)
+        for c in range(self.num_labels):
+            self._update_series(c, probs[:, c], labels[:, c])
+        self._update_series(self.num_labels, probs.reshape(-1), labels.reshape(-1))
+
+    def compute(self) -> float:
+        if self.average == "micro":
+            return self._auroc_series(self.num_labels)
+        per = np.array([self._auroc_series(c) for c in range(self.num_labels)])
+        saved = self.pos
+        self.pos = self.pos[: self.num_labels]
+        try:
+            return self._average(per, self.average)
+        finally:
+            self.pos = saved
+
+
+class MulticlassAveragePrecision(MulticlassAUROC):
+    def compute(self) -> float:
+        per = np.array([self._ap_series(c) for c in range(self.n_series)])
+        return self._average(per, self.average)
+
+
+class MultilabelAveragePrecision(MultilabelAUROC):
+    def compute(self) -> float:
+        if self.average == "micro":
+            return self._ap_series(self.num_labels)
+        per = np.array([self._ap_series(c) for c in range(self.num_labels)])
+        saved = self.pos
+        self.pos = self.pos[: self.num_labels]
+        try:
+            return self._average(per, self.average)
+        finally:
+            self.pos = saved
+
+
+class MeanSquaredError:
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        preds = np.asarray(preds, dtype=np.float64).reshape(-1)
+        labels = np.asarray(labels, dtype=np.float64).reshape(-1)
+        self.total += float(((preds - labels) ** 2).sum())
+        self.count += preds.size
+
+    def compute(self) -> float:
+        return self.total / self.count if self.count else float("nan")
+
+
+class MeanSquaredLogError:
+    """mean((log1p(pred) − log1p(label))²); inputs must be ≥ −1."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        preds = np.asarray(preds, dtype=np.float64).reshape(-1)
+        labels = np.asarray(labels, dtype=np.float64).reshape(-1)
+        with np.errstate(invalid="ignore"):
+            err = np.log1p(np.maximum(preds, -1.0)) - np.log1p(np.maximum(labels, -1.0))
+        self.total += float(np.nansum(err**2))
+        self.count += preds.size
+
+    def compute(self) -> float:
+        return self.total / self.count if self.count else float("nan")
+
+
+class ExplainedVariance:
+    """``1 − Var[y − ŷ]/Var[y]`` per output dim, then averaged.
+
+    ``multioutput``: ``uniform_average`` (reference ``macro``) or
+    ``variance_weighted`` (reference ``weighted``); scalar streams use a
+    single output dim.
+    """
+
+    def __init__(self, multioutput: str = "uniform_average"):
+        self.multioutput = multioutput
+        self._n = None
+
+    def _init_state(self, d: int) -> None:
+        self._n = np.zeros(d)
+        self._sum_y = np.zeros(d)
+        self._sum_y2 = np.zeros(d)
+        self._sum_e = np.zeros(d)
+        self._sum_e2 = np.zeros(d)
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        preds = np.asarray(preds, dtype=np.float64)
+        labels = np.asarray(labels, dtype=np.float64)
+        if preds.ndim <= 1:
+            preds = preds.reshape(-1, 1)
+            labels = labels.reshape(-1, 1)
+        else:
+            preds = preds.reshape(-1, preds.shape[-1])
+            labels = labels.reshape(-1, labels.shape[-1])
+        if self._n is None:
+            self._init_state(preds.shape[-1])
+        err = labels - preds
+        self._n += preds.shape[0]
+        self._sum_y += labels.sum(axis=0)
+        self._sum_y2 += (labels**2).sum(axis=0)
+        self._sum_e += err.sum(axis=0)
+        self._sum_e2 += (err**2).sum(axis=0)
+
+    def compute(self) -> float:
+        if self._n is None or not self._n.any():
+            return float("nan")
+        n = np.maximum(self._n, 1)
+        var_y = self._sum_y2 / n - (self._sum_y / n) ** 2
+        var_e = self._sum_e2 / n - (self._sum_e / n) ** 2
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ev = 1.0 - var_e / var_y
+        ev = np.where(var_y > 0, ev, 0.0)
+        if self.multioutput == "variance_weighted":
+            denom = var_y.sum()
+            return float((ev * var_y).sum() / denom) if denom > 0 else float("nan")
+        return float(ev.mean())

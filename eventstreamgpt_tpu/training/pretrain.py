@@ -1,0 +1,434 @@
+"""The generative pretraining harness: sharded train step, epoch loop, driver.
+
+TPU-native rebuild of the reference Lightning pretraining stack
+(``/root/reference/EventStream/transformer/lightning_modules/generative_modeling.py:45-698``):
+
+* ``ESTForGenerativeSequenceModelingLM.configure_optimizers`` → ``build_optimizer``
+  (AdamW + polynomial decay w/ warmup, optax).
+* Lightning DDP (``devices="auto"``) → a 1-D ``data`` mesh over
+  ``jax.devices()``; the batch is sharded over the mesh, parameters are
+  replicated, and gradient all-reduce is inserted by XLA under ``jit`` — no
+  explicit collectives (SURVEY §2.10/§5.8).
+* ``Trainer.fit`` + callbacks → an explicit epoch loop with tuning eval,
+  early stopping on ``tuning_loss`` (``EarlyStopping`` ≡
+  ``OptimizationConfig.patience``), LR logging (``LearningRateMonitor``),
+  and step-level orbax checkpoints with preemption-safe auto-resume (a
+  capability the reference lacks; SURVEY §5.3 calls it out as a must-add).
+* ``train()`` keeps the reference contract: seeds, builds train/tuning
+  datasets, ``set_to_dataset``, dumps the five config JSONs, fits, calls
+  ``save_pretrained``, then runs final tuning/held-out validation with the
+  full metrics config and writes ``tuning_metrics.json`` /
+  ``held_out_metrics.json``, returning ``tuning_loss``.
+
+W&B is replaced by a local JSONL train log (``train_log.jsonl`` in
+``save_dir``) — same information, no external service.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import time
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import serialization, struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.config import PytorchDatasetConfig
+from ..data.jax_dataset import JaxDataset
+from ..data.types import EventStreamBatch
+from ..models.ci_model import CIPPTForGenerativeSequenceModeling
+from ..models.config import (
+    MetricsConfig,
+    OptimizationConfig,
+    Split,
+    StructuredEventProcessingMode,
+    StructuredTransformerConfig,
+)
+from ..models.na_model import NAPPTForGenerativeSequenceModeling
+from ..utils import config_dataclass
+from .checkpoint import TrainCheckpointManager, save_pretrained
+from .generative_metrics import GenerativeMetrics
+from .optimizer import build_optimizer
+
+SKIP_CFG_PARAMS = {"seq_attention_layers", "dep_graph_attention_layers"}
+
+
+# --------------------------------------------------------------------- state
+@struct.dataclass
+class TrainState:
+    """Replicated training state — a pytree moved whole through ``jit``."""
+
+    step: jnp.ndarray  # scalar int32, counts optimizer steps
+    params: Any
+    opt_state: Any
+
+
+def build_model(config: StructuredTransformerConfig):
+    """CI vs NA model choice (reference ``generative_modeling.py:98-106``)."""
+    mode = config.structured_event_processing_mode
+    if mode == StructuredEventProcessingMode.NESTED_ATTENTION:
+        return NAPPTForGenerativeSequenceModeling(config)
+    if mode == StructuredEventProcessingMode.CONDITIONALLY_INDEPENDENT:
+        return CIPPTForGenerativeSequenceModeling(config)
+    raise ValueError(f"Unsupported structured event processing mode: {mode}")
+
+
+# ------------------------------------------------------------------ sharding
+def data_parallel_mesh(*batch_sizes: int) -> Mesh:
+    """A 1-D ``data`` mesh over the most devices that divide every batch size.
+
+    Falls back to fewer devices (largest common divisor) rather than failing —
+    a batch of 6 on 4 chips runs 2-way data-parallel. Passing both the train
+    and validation batch sizes yields one mesh usable for the whole run.
+    """
+    devices = jax.devices()
+    n = len(devices)
+    while n > 1 and any(bs % n != 0 for bs in batch_sizes):
+        n -= 1
+    return Mesh(np.asarray(devices[:n]), ("data",))
+
+
+def shard_batch(batch: EventStreamBatch, mesh: Mesh) -> EventStreamBatch:
+    """Device-puts a host batch sharded over the mesh's ``data`` axis."""
+    def put(x):
+        x = np.asarray(x)
+        sharding = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+# ----------------------------------------------------------------- train step
+def make_train_step(model, tx) -> Callable:
+    """A jitted ``(state, batch, rng) -> (state, loss)`` step.
+
+    Gradients reduce across the ``data`` axis automatically (XLA inserts the
+    psum for replicated-param/sharded-batch layouts). The state is donated so
+    parameters update in place on device.
+    """
+
+    def train_step(state: TrainState, batch: EventStreamBatch, rng: jax.Array):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            out = model.apply(params, batch, rngs={"dropout": dropout_rng})
+            return out.loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(step=state.step + 1, params=new_params, opt_state=new_opt_state),
+            loss,
+        )
+
+    return jax.jit(train_step, donate_argnums=(0,))
+
+
+def make_eval_step(model) -> Callable:
+    def eval_step(params, batch: EventStreamBatch):
+        return model.apply(params, batch)
+
+    return jax.jit(eval_step)
+
+
+# ------------------------------------------------------------------ eval loop
+def evaluate(
+    eval_step: Callable,
+    params: Any,
+    dataset: JaxDataset,
+    batch_size: int,
+    config: StructuredTransformerConfig,
+    metrics_config: MetricsConfig,
+    split: str,
+    mesh: Mesh | None = None,
+    key: jax.Array | None = None,
+) -> dict[str, float]:
+    """Runs one full-split eval pass, returning ``{split}_...`` metrics.
+
+    Fill rows in the final short batch are blanked + flagged by
+    ``valid_mask``; loss parts re-weight by the valid count so no subject is
+    double-counted (VERDICT weak #5).
+    """
+    metrics = GenerativeMetrics(config, metrics_config, split=split)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    for batch in dataset.batches(batch_size, shuffle=False, drop_last=False):
+        n_valid = int(np.asarray(batch.valid_mask).sum()) if batch.valid_mask is not None else None
+        if mesh is not None:
+            batch = shard_batch(batch, mesh)
+        out = eval_step(params, batch)
+        key, sub = jax.random.split(key)
+        metrics.update(out, key=sub, n_valid=n_valid)
+    return metrics.compute()
+
+
+# --------------------------------------------------------------------- config
+@config_dataclass
+class PretrainConfig:
+    """Pretraining driver config (reference ``PretrainConfig`` :491-552).
+
+    ``config`` holds ``StructuredTransformerConfig`` kwargs as a dict (the
+    reference's hydra ``_target_`` pattern; a ``_target_`` key is accepted
+    and ignored). ``save_dir`` supports ``${...}`` interpolation via
+    ``utils.config_tool``.
+    """
+
+    do_overwrite: bool = False
+    seed: int = 1
+
+    config: dict[str, Any] = dataclasses.field(default_factory=dict)
+    optimization_config: OptimizationConfig = dataclasses.field(default_factory=OptimizationConfig)
+    data_config: PytorchDatasetConfig = dataclasses.field(default_factory=PytorchDatasetConfig)
+    pretraining_metrics_config: MetricsConfig = dataclasses.field(
+        default_factory=lambda: MetricsConfig(do_skip_all_metrics=True)
+    )
+    final_validation_metrics_config: MetricsConfig = dataclasses.field(
+        default_factory=lambda: MetricsConfig(do_skip_all_metrics=False)
+    )
+
+    trainer_config: dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {
+            "log_every_n_steps": 10,
+            "checkpoint_every_n_steps": 100,
+            "max_checkpoints_to_keep": 2,
+            "profile_dir": None,
+        }
+    )
+
+    experiment_dir: str = "./experiments"
+    save_dir: str = "${experiment_dir}/pretrain"
+
+    do_final_validation_on_metrics: bool = True
+    do_resume_from_checkpoint: bool = True
+
+    def __post_init__(self):
+        if "max_epochs" in self.trainer_config:
+            raise ValueError("Max epochs is set in the optimization_config, not the trainer config!")
+
+    def build_model_config(self) -> StructuredTransformerConfig:
+        kwargs = {k: v for k, v in self.config.items() if k not in SKIP_CFG_PARAMS and k != "_target_"}
+        return StructuredTransformerConfig(**kwargs)
+
+
+# --------------------------------------------------------------------- driver
+def train(
+    cfg: PretrainConfig,
+    model_config: StructuredTransformerConfig | None = None,
+) -> tuple[float | None, dict | None, dict | None]:
+    """End-to-end pretraining (reference ``train`` :555-698).
+
+    Returns ``(tuning_loss, tuning_metrics, held_out_metrics)`` when final
+    validation runs, else ``(None, None, None)``.
+    """
+    np.random.seed(cfg.seed)
+    rng = jax.random.PRNGKey(cfg.seed)
+
+    train_pyd = JaxDataset(cfg.data_config, split="train")
+    tuning_pyd = JaxDataset(cfg.data_config, split="tuning")
+
+    config = model_config if model_config is not None else cfg.build_model_config()
+    optimization_config = cfg.optimization_config
+    data_config = cfg.data_config
+
+    config.set_to_dataset(train_pyd)
+    optimization_config.set_to_dataset(train_pyd)
+
+    save_dir = Path(cfg.save_dir)
+    is_main = jax.process_index() == 0
+    if is_main:
+        save_dir.mkdir(parents=True, exist_ok=True)
+        config_fp = save_dir / "config.json"
+        if config_fp.exists() and not cfg.do_overwrite and not cfg.do_resume_from_checkpoint:
+            raise FileExistsError(f"{config_fp} already exists!")
+        config.to_json_file(config_fp, do_overwrite=True)
+        data_config.to_json_file(save_dir / "data_config.json", do_overwrite=True)
+        optimization_config.to_json_file(save_dir / "optimization_config.json", do_overwrite=True)
+        cfg.pretraining_metrics_config.to_json_file(
+            save_dir / "pretraining_metrics_config.json", do_overwrite=True
+        )
+        cfg.final_validation_metrics_config.to_json_file(
+            save_dir / "final_validation_metrics_config.json", do_overwrite=True
+        )
+
+    model = build_model(config)
+    tx, lr_schedule = build_optimizer(optimization_config)
+
+    oc = optimization_config
+    mesh = data_parallel_mesh(oc.batch_size, oc.validation_batch_size)
+
+    # Initialize from the first training batch's shapes.
+    init_batch = next(train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed))
+    rng, init_rng = jax.random.split(rng)
+    params = model.init(init_rng, init_batch)
+    state = TrainState(
+        step=jnp.zeros((), dtype=jnp.int32), params=params, opt_state=tx.init(params)
+    )
+    state = replicate(state, mesh)
+
+    tc = dict(cfg.trainer_config)
+    log_every = int(tc.get("log_every_n_steps") or 10)
+    ckpt_every = int(tc.get("checkpoint_every_n_steps") or 100)
+    keep = int(tc.get("max_checkpoints_to_keep") or 2)
+    profile_dir = tc.get("profile_dir")
+
+    ckpt_mgr = TrainCheckpointManager(
+        save_dir / "model_checkpoints", max_to_keep=keep, save_interval_steps=1
+    )
+    start_epoch = 0
+    if cfg.do_resume_from_checkpoint and ckpt_mgr.latest_step() is not None:
+        template = serialization.to_state_dict(jax.device_get(state))
+        restored_sd, resumed_step = ckpt_mgr.restore(template)
+        state = serialization.from_state_dict(jax.device_get(state), restored_sd)
+        state = replicate(state, mesh)
+        meta = ckpt_mgr.metadata(resumed_step) or {}
+        start_epoch = int(meta.get("epoch", 0)) + 1
+        print(f"Resumed from checkpoint at step {resumed_step} (epoch {start_epoch})")
+
+    train_step = make_train_step(model, tx)
+    eval_step = make_eval_step(model)
+
+    log_fp = save_dir / "train_log.jsonl" if is_main else None
+
+    def log_record(rec: dict) -> None:
+        if log_fp is not None:
+            with open(log_fp, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    best_tuning_loss = float("inf")
+    epochs_since_best = 0
+    steps_per_epoch = len(train_pyd) // oc.batch_size
+    global_step = int(jax.device_get(state.step))
+    stop = False
+
+    for epoch in range(start_epoch, oc.max_epochs):
+        epoch_t0 = time.perf_counter()
+        window_t0, window_events, window_loss, window_n = time.perf_counter(), 0, 0.0, 0
+        for batch in train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed + epoch):
+            if profile_dir and global_step == 10:
+                jax.profiler.start_trace(str(profile_dir))
+            n_events = int(np.asarray(batch.event_mask).sum())
+            batch = shard_batch(batch, mesh)
+            state, loss = train_step(state, batch, rng)
+            global_step += 1
+            window_events += n_events
+            window_loss += float(loss)
+            window_n += 1
+            if profile_dir and global_step == 20:
+                jax.profiler.stop_trace()
+
+            if global_step % log_every == 0:
+                dt = time.perf_counter() - window_t0
+                rec = {
+                    "split": str(Split.TRAIN),
+                    "epoch": epoch,
+                    "step": global_step,
+                    "train_loss": window_loss / max(window_n, 1),
+                    "lr": float(lr_schedule(global_step)),
+                    "events_per_sec": window_events / dt if dt > 0 else None,
+                    "step_time_ms": 1000.0 * dt / max(window_n, 1),
+                }
+                log_record(rec)
+                window_t0, window_events, window_loss, window_n = time.perf_counter(), 0, 0.0, 0
+            if global_step % ckpt_every == 0:
+                ckpt_mgr.save(global_step, serialization.to_state_dict(jax.device_get(state)), metadata={"epoch": epoch})
+            if oc.max_training_steps is not None and global_step >= oc.max_training_steps:
+                stop = True
+                break
+
+        # Tuning eval (loss-only under the default pretraining metrics config).
+        rng, eval_key = jax.random.split(rng)
+        tuning_metrics = evaluate(
+            eval_step,
+            state.params,
+            tuning_pyd,
+            oc.validation_batch_size,
+            config,
+            cfg.pretraining_metrics_config,
+            Split.TUNING,
+            mesh=mesh,
+            key=eval_key,
+        )
+        tuning_loss = tuning_metrics.get("tuning_loss", float("nan"))
+        log_record(
+            {
+                "split": str(Split.TUNING),
+                "epoch": epoch,
+                "step": global_step,
+                **tuning_metrics,
+                "epoch_time_s": time.perf_counter() - epoch_t0,
+            }
+        )
+        print(
+            f"epoch {epoch}: step {global_step}/{oc.max_training_steps or steps_per_epoch * oc.max_epochs}"
+            f" tuning_loss={tuning_loss:.4f}"
+        )
+
+        ckpt_mgr.save(global_step, serialization.to_state_dict(jax.device_get(state)), metadata={"epoch": epoch})
+
+        # Early stopping (reference EarlyStopping(monitor="tuning_loss")).
+        if np.isfinite(tuning_loss) and tuning_loss < best_tuning_loss - 1e-12:
+            best_tuning_loss = tuning_loss
+            epochs_since_best = 0
+        else:
+            epochs_since_best += 1
+            if oc.patience is not None and epochs_since_best > oc.patience:
+                print(f"Early stopping at epoch {epoch} (patience {oc.patience})")
+                break
+        if stop:
+            break
+
+    ckpt_mgr.wait_until_finished()
+    params_host = jax.device_get(state.params)
+    if is_main:
+        save_pretrained(save_dir, params_host)
+
+    if not cfg.do_final_validation_on_metrics:
+        ckpt_mgr.close()
+        return None, None, None
+
+    held_out_pyd = JaxDataset(cfg.data_config, split="held_out")
+    rng, k1, k2 = jax.random.split(rng, 3)
+    final_tuning = evaluate(
+        eval_step,
+        state.params,
+        tuning_pyd,
+        oc.validation_batch_size,
+        config,
+        cfg.final_validation_metrics_config,
+        Split.TUNING,
+        mesh=mesh,
+        key=k1,
+    )
+    final_held_out = evaluate(
+        eval_step,
+        state.params,
+        held_out_pyd,
+        oc.validation_batch_size,
+        config,
+        cfg.final_validation_metrics_config,
+        Split.HELD_OUT,
+        mesh=mesh,
+        key=k2,
+    )
+
+    if is_main:
+        print("Saving final metrics...")
+        with open(save_dir / "tuning_metrics.json", "w") as f:
+            json.dump(final_tuning, f)
+        with open(save_dir / "held_out_metrics.json", "w") as f:
+            json.dump(final_held_out, f)
+
+    ckpt_mgr.close()
+    return final_tuning.get("tuning_loss"), final_tuning, final_held_out

@@ -288,6 +288,24 @@ class TestStoppingCriteria:
         )
         assert out.sequence_length == 5  # clamped from 3+5 to the criterion's 5
 
+    def test_explicit_max_length_beats_looser_criterion(self):
+        """An explicit smaller max_length is not overridden by a looser
+        MaxLengthCriteria in the list — every bound applies."""
+        config = ci_config()
+        batch = make_prompt(L=3)
+        model = CIPPTForGenerativeSequenceModeling(config)
+        params = model.init(jax.random.PRNGKey(0), batch)
+        out = generate(
+            model,
+            params,
+            batch,
+            config,
+            jax.random.PRNGKey(1),
+            max_length=5,
+            stopping_criteria=StoppingCriteriaList([MaxLengthCriteria(8)]),
+        )
+        assert out.sequence_length == 5
+
     def test_generate_returns_prompt_when_criterion_already_met(self):
         config = ci_config()
         batch = make_prompt(L=3)
