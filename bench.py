@@ -1,43 +1,73 @@
-"""Benchmark: pretraining throughput (events/sec/chip) on the flagship config.
+"""Benchmark: real-system pretraining throughput (events/sec/chip).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}. The
-baseline is the driver's north star of 5,000 events/sec/chip on the MIMIC-IV
-tutorial-scale CI pretrain config (BASELINE.json); vs_baseline = value / 5000.
+Measures the system the north star describes (BASELINE.json config 2 shape,
+MIMIC-IV-tutorial scale), not a resident synthetic batch: a DL-cache parquet
+dataset is written to disk, read back through ``JaxDataset``, host-collated
+inside the timed loop, sharded over the data-parallel mesh, and stepped with
+the production training harness (``eventstreamgpt_tpu.training``). Events are
+counted from the event mask (padding excluded).
 
-Runs on whatever device JAX selects (the real TPU chip under the driver;
-CPU elsewhere). Uses a synthetic batch shaped like the MIMIC-IV tutorial
-config: batch 32, seq 256, 16 data elements/event, vocab ~4k, hidden 256.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+vs_baseline = value / 5000 (the driver's north-star events/sec/chip target;
+the reference implementation publishes no numbers and cannot run in this
+image — see BASELINE.md).
 """
 
 import json
+import tempfile
 import time
+from pathlib import Path
 
 import numpy as np
+
+# MIMIC-IV tutorial-scale shape: ~4k unified vocab, seq 256, batch 32.
+N_TRAIN, N_TUNING = 512, 64
+N_EVENT_TYPES, N_LABS, N_MEDS = 40, 3500, 500
+BATCH, SEQ_LEN, HIDDEN = 32, 256, 256
+MEASURED_EPOCHS = 3
 
 
 def main():
     import jax
+
+    from eventstreamgpt_tpu.data import JaxDataset, PytorchDatasetConfig
+    from eventstreamgpt_tpu.data.synthetic import write_synthetic_dataset
+    from eventstreamgpt_tpu.models.config import (
+        MetricsConfig,
+        OptimizationConfig,
+        Split,
+        StructuredTransformerConfig,
+    )
+    from eventstreamgpt_tpu.training import (
+        TrainState,
+        build_model,
+        build_optimizer,
+        data_parallel_mesh,
+        evaluate,
+        make_eval_step,
+        make_train_step,
+        replicate,
+        shard_batch,
+    )
     import jax.numpy as jnp
-    import optax
 
-    from eventstreamgpt_tpu.data.types import EventStreamBatch
-    from eventstreamgpt_tpu.models.ci_model import CIPPTForGenerativeSequenceModeling
-    from eventstreamgpt_tpu.models.config import StructuredTransformerConfig
-
-    B, L, M = 32, 256, 16
-    VOCAB = 4096
-    HIDDEN = 256
+    # ---- on-disk data (generation not timed; IO + collation in the loop are).
+    data_dir = Path(tempfile.mkdtemp(prefix="esgpt_bench_"))
+    write_synthetic_dataset(
+        data_dir,
+        n_subjects_per_split={"train": N_TRAIN, "tuning": N_TUNING},
+        n_event_types=N_EVENT_TYPES,
+        n_labs=N_LABS,
+        n_meds=N_MEDS,
+        mean_seq_len=200,
+        max_seq_len=512,
+        seed=0,
+    )
+    data_config = PytorchDatasetConfig(save_dir=data_dir, max_seq_len=SEQ_LEN, min_seq_len=4)
+    train_ds = JaxDataset(data_config, "train")
+    tuning_ds = JaxDataset(data_config, "tuning")
 
     config = StructuredTransformerConfig(
-        vocab_sizes_by_measurement={"event_type": 40, "labs": VOCAB - 41},
-        vocab_offsets_by_measurement={"event_type": 1, "labs": 41},
-        measurements_idxmap={"event_type": 1, "labs": 2},
-        measurements_per_generative_mode={
-            "single_label_classification": ["event_type"],
-            "multi_label_classification": ["labs"],
-            "multivariate_regression": ["labs"],
-        },
-        max_seq_len=L,
         hidden_size=HIDDEN,
         head_dim=HIDDEN // 4,
         num_attention_heads=4,
@@ -48,60 +78,77 @@ def main():
         TTE_generation_layer_type="log_normal_mixture",
         TTE_lognormal_generation_num_components=3,
     )
+    config.set_to_dataset(train_ds)
 
-    rng = np.random.default_rng(0)
-    # One single-label event_type element per event; the rest are labs.
-    dyn_meas = np.full((B, L, M), 2, dtype=np.int64)
-    dyn_meas[:, :, 0] = 1
-    dyn_idx = np.where(
-        dyn_meas == 1,
-        rng.integers(1, 41, size=dyn_meas.shape),
-        rng.integers(41, VOCAB, size=dyn_meas.shape),
+    oc = OptimizationConfig(
+        init_lr=1e-3,
+        batch_size=BATCH,
+        validation_batch_size=BATCH,
+        max_epochs=MEASURED_EPOCHS,
+        lr_frac_warmup_steps=0.1,
     )
-    batch = EventStreamBatch(
-        event_mask=jnp.ones((B, L), dtype=bool),
-        time_delta=jnp.asarray(rng.uniform(0.5, 60.0, size=(B, L)).astype(np.float32)),
-        static_indices=jnp.asarray(rng.integers(1, VOCAB, size=(B, 4))),
-        static_measurement_indices=jnp.asarray(np.ones((B, 4), dtype=np.int64)),
-        dynamic_indices=jnp.asarray(dyn_idx),
-        dynamic_measurement_indices=jnp.asarray(dyn_meas),
-        dynamic_values=jnp.asarray(rng.normal(size=dyn_meas.shape).astype(np.float32)),
-        dynamic_values_mask=jnp.asarray((dyn_meas == 2) & (rng.random(dyn_meas.shape) < 0.5)),
-    )
+    oc.set_to_dataset(train_ds)
 
-    model = CIPPTForGenerativeSequenceModeling(config)
-    params = model.init(jax.random.PRNGKey(0), batch)
-    tx = optax.adamw(1e-3)
-    opt_state = tx.init(params)
+    model = build_model(config)
+    tx, _ = build_optimizer(oc)
+    mesh = data_parallel_mesh(BATCH)
+    n_devices = int(mesh.devices.size)
 
-    @jax.jit
-    def train_step(params, opt_state, batch):
-        def loss_fn(p):
-            return model.apply(p, batch).loss
+    init_batch = next(train_ds.batches(BATCH, shuffle=True, seed=0))
+    params = model.init(jax.random.PRNGKey(0), init_batch)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
+    state = replicate(state, mesh)
+    train_step = make_train_step(model, tx)
+    rng = jax.random.PRNGKey(0)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
-
-    # Warmup/compile.
-    params, opt_state, loss = train_step(params, opt_state, batch)
+    # Warmup: one step to compile.
+    state, loss = train_step(state, shard_batch(init_batch, mesh), rng)
     jax.block_until_ready(loss)
 
-    n_steps = 20
+    # ---- measured: full epochs with host IO + collation in the loop.
+    n_steps = 0
+    n_events = 0
+    loss = None
     t0 = time.perf_counter()
-    for _ in range(n_steps):
-        params, opt_state, loss = train_step(params, opt_state, batch)
+    for epoch in range(MEASURED_EPOCHS):
+        for batch in train_ds.batches(BATCH, shuffle=True, seed=1 + epoch):
+            n_events += int(np.asarray(batch.event_mask).sum())
+            state, loss = train_step(state, shard_batch(batch, mesh), rng)
+            n_steps += 1
+    # Donated-state data dependence orders every prior step before this sync.
     jax.block_until_ready(loss)
     elapsed = time.perf_counter() - t0
 
-    events_per_sec = (B * L * n_steps) / elapsed
+    final_train_loss = float(loss)
+    events_per_sec_per_chip = n_events / elapsed / n_devices
+
+    # Held-out quality signal: tuning NLL via the production eval loop.
+    eval_metrics = evaluate(
+        make_eval_step(model),
+        state.params,
+        tuning_ds,
+        BATCH,
+        config,
+        MetricsConfig(do_skip_all_metrics=True),
+        Split.TUNING,
+        mesh=mesh,
+        key=jax.random.PRNGKey(1),
+    )
+
     print(
         json.dumps(
             {
                 "metric": "pretrain_events_per_sec_per_chip",
-                "value": round(events_per_sec, 1),
+                "value": round(events_per_sec_per_chip, 1),
                 "unit": "events/sec/chip",
-                "vs_baseline": round(events_per_sec / 5000.0, 3),
+                "vs_baseline": round(events_per_sec_per_chip / 5000.0, 3),
+                "step_time_ms": round(1000.0 * elapsed / n_steps, 2),
+                "steps": n_steps,
+                "events": n_events,
+                "n_devices": n_devices,
+                "final_train_loss": round(final_train_loss, 4),
+                "tuning_loss": round(eval_metrics.get("tuning_loss", float("nan")), 4),
+                "host_input_pipeline": True,
             }
         )
     )

@@ -644,12 +644,23 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         return EventStreamBatch(**out)
 
     # -------------------------------------------------------------- batching
+    def _consume_collation_rng(self, subject_indices: np.ndarray, rng: np.random.Generator):
+        """Advances ``rng`` exactly as `collate_indices` would, without
+        collating — the fast-forward path for mid-epoch resume."""
+        if self.config.subsequence_sampling_strategy == SubsequenceSamplingStrategy.RANDOM:
+            d = self.data
+            idx = np.asarray(subject_indices)
+            seq_lens = d.subject_event_offsets[idx + 1] - d.subject_event_offsets[idx]
+            over = seq_lens > self.max_seq_len
+            rng.integers(0, seq_lens[over] - self.max_seq_len)
+
     def batches(
         self,
         batch_size: int,
         shuffle: bool = True,
         seed: int | None = None,
         drop_last: bool | None = None,
+        skip_batches: int = 0,
     ):
         """Yields `EventStreamBatch`es of exactly ``batch_size`` subjects.
 
@@ -661,6 +672,11 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         never double-count subjects: weight per-subject metrics (incl.
         ``stream_labels``) by ``valid_mask``. With ``drop_last=True``
         (default when shuffling, i.e. training) the remainder is dropped.
+
+        ``skip_batches`` fast-forwards past the first N batches without
+        collating them (mid-epoch resume after preemption): the rng stream is
+        advanced identically, so batch N+1 onward is bitwise-identical to an
+        uninterrupted epoch.
         """
         n = len(self)
         if drop_last is None:
@@ -668,7 +684,7 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         rng = np.random.default_rng(seed)
         order = rng.permutation(n) if shuffle else np.arange(n)
         stop = n - (n % batch_size) if drop_last else n
-        for lo in range(0, stop, batch_size):
+        for i, lo in enumerate(range(0, stop, batch_size)):
             idx = order[lo : lo + batch_size]
             n_real = len(idx)
             if n_real < batch_size:
@@ -676,6 +692,9 @@ class JaxDataset(SeedableMixin, TimeableMixin):
                 # batch_size exceeds the dataset size.
                 fill = np.resize(order, batch_size - n_real)
                 idx = np.concatenate([idx, fill])
+            if i < skip_batches:
+                self._consume_collation_rng(idx, rng)
+                continue
             b = self.collate_indices(idx, rng=rng)
             valid = np.arange(batch_size) < n_real
             if n_real < batch_size:

@@ -39,7 +39,7 @@ class StoppingCriteriaList(list, StoppingCriteria):
 
     @property
     def max_length(self) -> int | None:
-        for criterion in self:
-            if isinstance(criterion, MaxLengthCriteria):
-                return criterion.max_length
-        return None
+        """The tightest max length across members (any member firing stops
+        generation, so the minimum is the binding one)."""
+        lengths = [c.max_length for c in self if isinstance(c, MaxLengthCriteria)]
+        return min(lengths) if lengths else None

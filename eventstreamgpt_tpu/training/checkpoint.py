@@ -89,11 +89,27 @@ class TrainCheckpointManager:
 
     def save(self, step: int, state: Any, metadata: dict | None = None) -> bool:
         saved = self._mgr.save(step, args=ocp.args.PyTreeSave(state))
-        if saved and metadata is not None:
-            # Metadata rides next to the manager root; small, human-readable.
+        # Metadata rides next to the manager root; small, human-readable. It
+        # is (re)written even when the array save was skipped because the step
+        # already exists — e.g. the epoch-end save landing on the same step as
+        # an in-loop save must still upgrade the metadata to epoch_complete.
+        if metadata is not None and (saved or step in self._mgr.all_steps()):
             with open(self.ckpt_dir / f"metadata_{step}.json", "w") as f:
                 json.dump(metadata, f)
+        if saved:
+            self._prune_metadata()
         return saved
+
+    def _prune_metadata(self) -> None:
+        """Drops metadata sidecars whose checkpoint the manager has deleted."""
+        live = set(self._mgr.all_steps())
+        for fp in self.ckpt_dir.glob("metadata_*.json"):
+            try:
+                step = int(fp.stem.split("_")[-1])
+            except ValueError:
+                continue
+            if step not in live:
+                fp.unlink(missing_ok=True)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

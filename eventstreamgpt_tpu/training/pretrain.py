@@ -269,6 +269,12 @@ def train(
     mesh = data_parallel_mesh(oc.batch_size, oc.validation_batch_size)
 
     # Initialize from the first training batch's shapes.
+    if len(train_pyd) < oc.batch_size:
+        raise ValueError(
+            f"Train split has {len(train_pyd)} subjects but batch_size is "
+            f"{oc.batch_size}; training batches drop the last short batch, so "
+            "no batch can be formed. Lower optimization_config.batch_size."
+        )
     init_batch = next(train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed))
     rng, init_rng = jax.random.split(rng)
     params = model.init(init_rng, init_batch)
@@ -287,14 +293,25 @@ def train(
         save_dir / "model_checkpoints", max_to_keep=keep, save_interval_steps=1
     )
     start_epoch = 0
+    skip_batches = 0
     if cfg.do_resume_from_checkpoint and ckpt_mgr.latest_step() is not None:
         template = serialization.to_state_dict(jax.device_get(state))
         restored_sd, resumed_step = ckpt_mgr.restore(template)
         state = serialization.from_state_dict(jax.device_get(state), restored_sd)
         state = replicate(state, mesh)
         meta = ckpt_mgr.metadata(resumed_step) or {}
-        start_epoch = int(meta.get("epoch", 0)) + 1
-        print(f"Resumed from checkpoint at step {resumed_step} (epoch {start_epoch})")
+        if meta.get("epoch_complete", True):
+            start_epoch = int(meta.get("epoch", 0)) + 1
+        else:
+            # Mid-epoch (preemption) checkpoint: the epoch's batch order is
+            # deterministic (seeded by cfg.seed + epoch), so re-enter the same
+            # epoch and skip the batches already trained on.
+            start_epoch = int(meta.get("epoch", 0))
+            skip_batches = int(meta.get("step_in_epoch", 0))
+        print(
+            f"Resumed from checkpoint at step {resumed_step} "
+            f"(epoch {start_epoch}, skipping {skip_batches} batches)"
+        )
 
     train_step = make_train_step(model, tx)
     eval_step = make_eval_step(model)
@@ -310,23 +327,38 @@ def train(
     epochs_since_best = 0
     steps_per_epoch = len(train_pyd) // oc.batch_size
     global_step = int(jax.device_get(state.step))
+    # max_training_steps counts *optimizer* steps (what the LR schedule sees);
+    # with gradient accumulation each optimizer step spans `accum` loop steps.
+    accum = oc.gradient_accumulation or 1
     stop = False
+    profiling = False
 
     for epoch in range(start_epoch, oc.max_epochs):
         epoch_t0 = time.perf_counter()
-        window_t0, window_events, window_loss, window_n = time.perf_counter(), 0, 0.0, 0
-        for batch in train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed + epoch):
-            if profile_dir and global_step == 10:
+        window_t0, window_events, window_n = time.perf_counter(), 0, 0
+        window_losses: list = []
+        epoch_skip = skip_batches if epoch == start_epoch else 0
+        for step_in_epoch, batch in enumerate(
+            train_pyd.batches(
+                oc.batch_size, shuffle=True, seed=cfg.seed + epoch, skip_batches=epoch_skip
+            ),
+            start=epoch_skip,
+        ):
+            if profile_dir and not profiling and 10 <= global_step < 20:
                 jax.profiler.start_trace(str(profile_dir))
+                profiling = True
             n_events = int(np.asarray(batch.event_mask).sum())
             batch = shard_batch(batch, mesh)
             state, loss = train_step(state, batch, rng)
             global_step += 1
             window_events += n_events
-            window_loss += float(loss)
+            # Keep the loss on device: converting every step would sync the
+            # host with the device and serialize collation with compute.
+            window_losses.append(loss)
             window_n += 1
-            if profile_dir and global_step == 20:
+            if profiling and global_step >= 20:
                 jax.profiler.stop_trace()
+                profiling = False
 
             if global_step % log_every == 0:
                 dt = time.perf_counter() - window_t0
@@ -334,18 +366,33 @@ def train(
                     "split": str(Split.TRAIN),
                     "epoch": epoch,
                     "step": global_step,
-                    "train_loss": window_loss / max(window_n, 1),
-                    "lr": float(lr_schedule(global_step)),
+                    "train_loss": float(jnp.mean(jnp.stack(window_losses))),
+                    "lr": float(lr_schedule(global_step // accum)),
                     "events_per_sec": window_events / dt if dt > 0 else None,
                     "step_time_ms": 1000.0 * dt / max(window_n, 1),
                 }
                 log_record(rec)
-                window_t0, window_events, window_loss, window_n = time.perf_counter(), 0, 0.0, 0
+                window_t0, window_events, window_n = time.perf_counter(), 0, 0
+                window_losses = []
             if global_step % ckpt_every == 0:
-                ckpt_mgr.save(global_step, serialization.to_state_dict(jax.device_get(state)), metadata={"epoch": epoch})
-            if oc.max_training_steps is not None and global_step >= oc.max_training_steps:
+                ckpt_mgr.save(
+                    global_step,
+                    serialization.to_state_dict(jax.device_get(state)),
+                    metadata={
+                        "epoch": epoch,
+                        "epoch_complete": False,
+                        "step_in_epoch": step_in_epoch + 1,
+                    },
+                )
+            if (
+                oc.max_training_steps is not None
+                and global_step // accum >= oc.max_training_steps
+            ):
                 stop = True
                 break
+        if profiling:
+            jax.profiler.stop_trace()
+            profiling = False
 
         # Tuning eval (loss-only under the default pretraining metrics config).
         rng, eval_key = jax.random.split(rng)
@@ -371,11 +418,16 @@ def train(
             }
         )
         print(
-            f"epoch {epoch}: step {global_step}/{oc.max_training_steps or steps_per_epoch * oc.max_epochs}"
+            f"epoch {epoch}: opt step {global_step // accum}/"
+            f"{oc.max_training_steps or steps_per_epoch * oc.max_epochs}"
             f" tuning_loss={tuning_loss:.4f}"
         )
 
-        ckpt_mgr.save(global_step, serialization.to_state_dict(jax.device_get(state)), metadata={"epoch": epoch})
+        ckpt_mgr.save(
+            global_step,
+            serialization.to_state_dict(jax.device_get(state)),
+            metadata={"epoch": epoch, "epoch_complete": True},
+        )
 
         # Early stopping (reference EarlyStopping(monitor="tuning_loss")).
         if np.isfinite(tuning_loss) and tuning_loss < best_tuning_loss - 1e-12:
@@ -383,7 +435,9 @@ def train(
             epochs_since_best = 0
         else:
             epochs_since_best += 1
-            if oc.patience is not None and epochs_since_best > oc.patience:
+            # Lightning EarlyStopping semantics: stop once the wait count
+            # reaches patience (the Nth consecutive non-improving epoch).
+            if oc.patience is not None and epochs_since_best >= max(oc.patience, 1):
                 print(f"Early stopping at epoch {epoch} (patience {oc.patience})")
                 break
         if stop:

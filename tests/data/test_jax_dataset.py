@@ -172,6 +172,23 @@ class TestCollation:
         assert not em[n_real:].any()  # fill rows fully masked
         assert not vm[n_real:].any()
 
+    def test_skip_batches_fast_forward_is_bitwise_identical(self, sample_dir):
+        """Mid-epoch resume: skipping N batches advances the rng identically,
+        so the remaining batches match an uninterrupted epoch exactly."""
+        # Small max_seq_len so random subsequence sampling consumes the rng.
+        cfg = make_config(sample_dir, max_seq_len=4)
+        ds = JaxDataset(cfg, "tuning")
+        full = list(ds.batches(batch_size=2, shuffle=True, seed=7))
+        assert len(full) >= 2
+        resumed = list(ds.batches(batch_size=2, shuffle=True, seed=7, skip_batches=1))
+        assert len(resumed) == len(full) - 1
+        for a, b in zip(full[1:], resumed):
+            np.testing.assert_array_equal(np.asarray(a.event_mask), np.asarray(b.event_mask))
+            np.testing.assert_array_equal(
+                np.asarray(a.dynamic_indices), np.asarray(b.dynamic_indices)
+            )
+            np.testing.assert_array_equal(np.asarray(a.time_delta), np.asarray(b.time_delta))
+
     def test_start_time_and_subject_id(self, sample_dir):
         cfg = make_config(
             sample_dir,

@@ -271,6 +271,12 @@ class TestStoppingCriteria:
         assert crits.max_length == 5
         assert crits(make_prompt(L=3), n_events=7)
 
+    def test_list_max_length_is_tightest(self):
+        """Any member firing stops generation, so the min length binds —
+        including when the bound is folded into max_new_events."""
+        crits = StoppingCriteriaList([MaxLengthCriteria(20), MaxLengthCriteria(8)])
+        assert crits.max_length == 8
+
     def test_generate_consumes_max_length_criteria(self):
         """A MaxLengthCriteria inside generate() bounds the generated length."""
         config = ci_config()

@@ -186,6 +186,42 @@ class TestTrainDriver:
         # records are appended in order, so the last train record's epoch is 1.
         assert [r for r in records if r["split"] == "train"][-1]["epoch"] == 1
 
+    def test_mid_epoch_preemption_resume(self, sample_dir, tmp_path):
+        """Resume from a mid-epoch (preemption) checkpoint re-enters the same
+        epoch and skips exactly the batches already trained on."""
+        cfg = make_pretrain_config(sample_dir, tmp_path, max_epochs=1)
+        cfg.do_final_validation_on_metrics = False
+        cfg.trainer_config = {
+            "log_every_n_steps": 1,
+            "checkpoint_every_n_steps": 1,
+            "max_checkpoints_to_keep": 50,
+        }
+        train(cfg)
+        save_dir = Path(cfg.save_dir)
+
+        # Simulate preemption after step 1: drop the later checkpoint so the
+        # latest checkpoint is the mid-epoch one (epoch 0, 1 batch done).
+        ck_root = save_dir / "model_checkpoints"
+        for step_dir in ck_root.iterdir():
+            if step_dir.is_dir() and step_dir.name.isdigit() and int(step_dir.name) > 1:
+                shutil.rmtree(step_dir)
+        meta1 = json.loads((ck_root / "metadata_1.json").read_text())
+        assert meta1 == {"epoch": 0, "epoch_complete": False, "step_in_epoch": 1}
+        (save_dir / "train_log.jsonl").unlink()
+
+        cfg2 = make_pretrain_config(sample_dir, tmp_path, max_epochs=1)
+        cfg2.do_final_validation_on_metrics = False
+        cfg2.do_overwrite = True
+        cfg2.trainer_config = {"log_every_n_steps": 1, "checkpoint_every_n_steps": 100}
+        train(cfg2)
+
+        records = [
+            json.loads(line) for line in (save_dir / "train_log.jsonl").open()
+        ]
+        train_recs = [r for r in records if r["split"] == "train"]
+        # Epoch 0 had 2 batches; 1 was done pre-preemption → exactly 1 remains.
+        assert [(r["epoch"], r["step"]) for r in train_recs] == [(0, 2)]
+
     def test_early_stopping(self, sample_dir, tmp_path):
         cfg = make_pretrain_config(sample_dir, tmp_path, max_epochs=50, patience=0, init_lr=1e-12)
         # Negligible LR with patience 0: no improvement after epoch 1 → stop early.
