@@ -8,6 +8,8 @@ from .config import (
     SubsequenceSamplingStrategy,
     VocabularyConfig,
 )
+from .dataset_base import DatasetBase
+from .dataset_pandas import Dataset, Query
 from .jax_dataset import JaxDataset
 from .time_dependent_functor import AgeFunctor, TimeDependentFunctor, TimeOfDayFunctor
 from .types import (
@@ -24,8 +26,11 @@ from .vocabulary import Vocabulary
 __all__ = [
     "AgeFunctor",
     "DataModality",
+    "Dataset",
+    "DatasetBase",
     "DatasetConfig",
     "DatasetSchema",
+    "Query",
     "EventStreamBatch",
     "InputDataType",
     "InputDFSchema",

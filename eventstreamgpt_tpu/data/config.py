@@ -653,7 +653,9 @@ class MeasurementConfig(JSONableMixin):
                         raise ValueError(
                             f"Expected a single-column dataframe for univariate regression; got {out}"
                         )
-                    out = out.iloc[:, 0]
+                    # object dtype so dict-valued cells can be assigned (the
+                    # default arrow-backed string dtype rejects them).
+                    out = out.iloc[:, 0].astype(object)
                     for col in ("outlier_model", "normalizer"):
                         if col in out.index and isinstance(out[col], str):
                             out[col] = eval(out[col])  # noqa: S307 — own artifact round-trip.

@@ -1,0 +1,791 @@
+"""The abstract ETL/preprocessing engine for event-stream datasets.
+
+Rebuild of ``/root/reference/EventStream/data/dataset_base.py:41``
+(``DatasetBase``): the backend-agnostic pipeline that
+
+1. builds subjects/events/measurements dataframes from ``InputDFSchema``s,
+2. splits subjects into train/tuning/held-out,
+3. preprocesses (filter subjects → add time-dependent measures → fit
+   per-measurement metadata + vocabularies on train → transform all splits),
+4. saves/loads the processed dataset directory, and
+5. writes the deep-learning cache (``DL_reps/{split}_{chunk}.parquet``) plus
+   the unified ``VocabularyConfig`` that the model layer consumes.
+
+Orchestration, ordering, and on-disk artifacts match the reference; the
+dataframe ops are deferred to a backend subclass (the pandas backend in
+``dataset_pandas.py`` — the reference's Polars is not available in this
+image, see that module's docstring).
+"""
+
+from __future__ import annotations
+
+import abc
+import copy
+import itertools
+import json
+import pickle
+from collections import defaultdict
+from pathlib import Path
+from typing import Any, Generic, Hashable, Sequence, TypeVar
+
+import numpy as np
+import pandas as pd
+
+from ..utils import SeedableMixin, TimeableMixin, count_or_proportion, lt_count_or_proportion
+from .config import (
+    DatasetConfig,
+    DatasetSchema,
+    InputDFSchema,
+    MeasurementConfig,
+    VocabularyConfig,
+)
+from .types import DataModality, InputDFType, TemporalityType
+from .vocabulary import Vocabulary
+
+DF_T = TypeVar("DF_T")
+INPUT_DF_T = TypeVar("INPUT_DF_T")
+
+
+class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMixin):
+    """A unified base class for dataset objects using different processing libraries.
+
+    Reference: ``dataset_base.py:41-86``. Subclasses supply the concrete
+    dataframe operations via the abstract ``_*`` methods.
+    """
+
+    SUBJECTS_FN = "subjects_df.parquet"
+    EVENTS_FN = "events_df.parquet"
+    DYNAMIC_MEASUREMENTS_FN = "dynamic_measurements_df.parquet"
+    DF_SAVE_FORMAT = "parquet"
+
+    PREPROCESSORS: dict[str, type] = {}
+
+    @classmethod
+    def subjects_fp(cls, save_dir: Path) -> Path:
+        return Path(save_dir) / cls.SUBJECTS_FN
+
+    @classmethod
+    def events_fp(cls, save_dir: Path) -> Path:
+        return Path(save_dir) / cls.EVENTS_FN
+
+    @classmethod
+    def dynamic_measurements_fp(cls, save_dir: Path) -> Path:
+        return Path(save_dir) / cls.DYNAMIC_MEASUREMENTS_FN
+
+    # ------------------------------------------------- abstract backend ops
+    @classmethod
+    @abc.abstractmethod
+    def _load_input_df(cls, df, columns, subject_id_col=None, subject_ids_map=None,
+                       subject_id_dtype=None, filter_on=None, subject_id_source_col=None):
+        """Loads an input dataframe into the backend's format (``dataset_polars.py:147``)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def _process_events_and_measurements_df(cls, df, event_type, columns_schema):
+        """Splits one input df into (events_df, measurements_df | None) (``:311``)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def _split_range_events_df(cls, df):
+        """Splits a range df into EQ/start/end event dfs (``:357``)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def _inc_df_col(cls, df, col, inc_by):
+        """Increments an integer column by a constant (``:384``)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def _concat_dfs(cls, dfs):
+        """Diagonally concatenates dataframes (``:390``)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def _resolve_ts_col(cls, df, ts_col, out_name="timestamp"):
+        """Unifies one-or-multiple timestamp columns into ``out_name`` (``:299``)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def _rename_cols(cls, df, to_rename):
+        """Renames columns (``:271``)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def _read_df(cls, fp: Path, **kwargs):
+        """Reads a dataframe from disk (``:394``)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def _write_df(cls, df, fp: Path, **kwargs):
+        """Writes a dataframe to disk, honoring ``do_overwrite`` (``:398``)."""
+
+    @classmethod
+    @abc.abstractmethod
+    def _filter_col_inclusion(cls, df, col_inclusion_targets: dict[str, bool | Sequence[Any]]):
+        """Filters rows via {col: True (non-null) | False (null) | values} (``:707``)."""
+
+    @abc.abstractmethod
+    def _validate_initial_dfs(self, subjects_df, events_df, dynamic_measurements_df):
+        """Validates input dfs and shrinks dtypes (``dataset_base.py:594``)."""
+
+    @abc.abstractmethod
+    def _update_subject_event_properties(self):
+        """Updates ``subject_ids`` / ``event_types`` / ``n_events_per_subject`` (``:601``)."""
+
+    @abc.abstractmethod
+    def _agg_by_time(self):
+        """Aggregates events into temporal buckets (``:622``, ``dataset_polars.py:643``)."""
+
+    @abc.abstractmethod
+    def _sort_events(self):
+        """Sorts events by subject and timestamp (``:635``)."""
+
+    @abc.abstractmethod
+    def _add_time_dependent_measurements(self):
+        """Evaluates functional-time-dependent functors onto events_df (``:775``)."""
+
+    @abc.abstractmethod
+    def _total_possible_and_observed(self, measure, config, source_df):
+        """(total possible, total observed) instances for a measure (``:882``)."""
+
+    @abc.abstractmethod
+    def _fit_measurement_metadata(self, measure, config, source_df) -> pd.DataFrame:
+        """Fits numeric pre-processing metadata (``:900``)."""
+
+    @abc.abstractmethod
+    def _fit_vocabulary(self, measure, config, source_df) -> Vocabulary:
+        """Fits the categorical vocabulary (``:916``)."""
+
+    @abc.abstractmethod
+    def _update_attr_df(self, attr, id_col, df, cols_to_update):
+        """Writes transformed columns back into an internal df (``:959``)."""
+
+    @abc.abstractmethod
+    def _transform_numerical_measurement(self, measure, config, source_df):
+        """Applies bounds/outlier/normalizer transforms (``:970``)."""
+
+    @abc.abstractmethod
+    def _transform_categorical_measurement(self, measure, config, source_df):
+        """Applies vocabulary filtering / categorization (``:993``)."""
+
+    @abc.abstractmethod
+    def build_DL_cached_representation(self, subject_ids=None, do_sort_outputs=False):
+        """Produces the one-row-per-subject DL dataframe (``:1182``)."""
+
+    @abc.abstractmethod
+    def _denormalize(self, events_df, col: str):
+        """Un-normalizes column ``col`` (``:1191``)."""
+
+    # --------------------------------------------------------- construction
+    @classmethod
+    def build_subjects_dfs(cls, schema: InputDFSchema) -> tuple[DF_T, dict[Hashable, int]]:
+        """Builds the subjects df + raw→numeric subject ID map (``dataset_base.py:179``)."""
+        from .types import InputDataType
+
+        subjects_df, ID_map = cls._load_input_df(
+            schema.input_df,
+            [(schema.subject_id_col, InputDataType.CATEGORICAL)] + schema.columns_to_load,
+            filter_on=schema.filter_on,
+            subject_id_source_col=schema.subject_id_col,
+        )
+        subjects_df = cls._rename_cols(
+            subjects_df, {i: o for i, (o, _) in schema.unified_schema.items()}
+        )
+        return subjects_df, ID_map
+
+    @classmethod
+    def build_event_and_measurement_dfs(
+        cls,
+        subject_ids_map: dict[Any, int],
+        subject_id_col: str,
+        subject_id_dtype: Any,
+        schemas_by_df: dict[Any, list[InputDFSchema]],
+    ) -> tuple[DF_T, DF_T]:
+        """Builds events + measurements dfs from the schema map (``dataset_base.py:202``)."""
+        all_events_and_measurements = []
+        event_types = []
+
+        for df, schemas in schemas_by_df.items():
+            all_columns = list(itertools.chain.from_iterable(s.columns_to_load for s in schemas))
+
+            try:
+                df = cls._load_input_df(
+                    df, all_columns, subject_id_col, subject_ids_map, subject_id_dtype
+                )
+            except Exception as e:
+                raise ValueError(f"Errored while loading {df}") from e
+
+            for schema in schemas:
+                sub_df = df
+                if schema.filter_on:
+                    sub_df = cls._filter_col_inclusion(sub_df, schema.filter_on)
+                if schema.type == InputDFType.EVENT:
+                    sub_df = cls._resolve_ts_col(sub_df, schema.ts_col, "timestamp")
+                    all_events_and_measurements.append(
+                        cls._process_events_and_measurements_df(
+                            df=sub_df, event_type=schema.event_type,
+                            columns_schema=schema.unified_schema,
+                        )
+                    )
+                    event_types.append(schema.event_type)
+                elif schema.type == InputDFType.RANGE:
+                    sub_df = cls._resolve_ts_col(sub_df, schema.start_ts_col, "start_time")
+                    sub_df = cls._resolve_ts_col(sub_df, schema.end_ts_col, "end_time")
+                    for et, unified_schema, sp_df in zip(
+                        schema.event_type, schema.unified_schema, cls._split_range_events_df(sub_df)
+                    ):
+                        all_events_and_measurements.append(
+                            cls._process_events_and_measurements_df(
+                                sp_df, columns_schema=unified_schema, event_type=et
+                            )
+                        )
+                    event_types.extend(schema.event_type)
+                else:
+                    raise ValueError(f"Invalid schema type {schema.type}.")
+
+        all_events, all_measurements = [], []
+        running_event_id_max = 0
+        for event_type, (events, measurements) in zip(event_types, all_events_and_measurements):
+            try:
+                new_events = cls._inc_df_col(events, "event_id", running_event_id_max)
+            except Exception as e:
+                raise ValueError(f"Failed to increment event_id on {event_type}") from e
+
+            if len(new_events) == 0:
+                print(f"Empty new events dataframe of type {event_type}!")
+                continue
+
+            all_events.append(new_events)
+            if measurements is not None:
+                all_measurements.append(cls._inc_df_col(measurements, "event_id", running_event_id_max))
+
+            running_event_id_max = int(all_events[-1]["event_id"].max()) + 1
+
+        return cls._concat_dfs(all_events), cls._concat_dfs(all_measurements)
+
+    @classmethod
+    def _get_preprocessing_model(cls, model_config: dict[str, Any], for_fit: bool = False):
+        """Resolves a preprocessor class/instance from config (``dataset_base.py:286``).
+
+        Examples:
+            >>> class MockPreprocessor:
+            ...     def __init__(self, name: str = ""):
+            ...         self.name = name
+            >>> class D(DatasetBase):
+            ...     PREPROCESSORS = {"mock": MockPreprocessor}
+            >>> D.__abstractmethods__ = frozenset()
+            >>> D._get_preprocessing_model({"cls": "mock", "name": "a"}, for_fit=True).name
+            'a'
+            >>> D._get_preprocessing_model({"cls": "mock"}, for_fit=False)
+            <class '...MockPreprocessor'>
+            >>> D._get_preprocessing_model({}, for_fit=True)
+            Traceback (most recent call last):
+                ...
+            KeyError: "Missing mandatory preprocessor class configuration parameter `'cls'`."
+        """
+        if "cls" not in model_config:
+            raise KeyError("Missing mandatory preprocessor class configuration parameter `'cls'`.")
+        if model_config["cls"] not in cls.PREPROCESSORS:
+            raise KeyError(
+                f"Invalid preprocessor model class {model_config['cls']}! {cls.__name__} options are "
+                f"{', '.join(cls.PREPROCESSORS.keys())}"
+            )
+
+        model_cls = cls.PREPROCESSORS[model_config["cls"]]
+        if not for_fit:
+            return model_cls
+        return model_cls(**{k: v for k, v in model_config.items() if k != "cls"})
+
+    # ------------------------------------------------------------- save/load
+    @classmethod
+    def load(cls, load_dir: Path) -> "DatasetBase":
+        """Re-loads a saved dataset directory (``dataset_base.py:412``)."""
+        load_dir = Path(load_dir)
+        attrs_fp = load_dir / "E.pkl"
+        with open(attrs_fp, "rb") as f:
+            attrs = pickle.load(f)
+
+        attrs["config"] = DatasetConfig.from_json_file(load_dir / "config.json")
+        inferred_fp = load_dir / "inferred_measurement_configs.json"
+        if inferred_fp.is_file():
+            with open(inferred_fp) as f:
+                attrs["inferred_measurement_configs"] = {
+                    k: MeasurementConfig.from_dict(v) for k, v in json.load(f).items()
+                }
+
+        obj = cls.__new__(cls)
+        for k, v in attrs.items():
+            setattr(obj, k, v)
+
+        for attr, fp_fn in (
+            ("subjects_df", cls.subjects_fp),
+            ("events_df", cls.events_fp),
+            ("dynamic_measurements_df", cls.dynamic_measurements_fp),
+        ):
+            fp = fp_fn(load_dir)
+            setattr(obj, attr, cls._read_df(fp) if fp.is_file() else None)
+        return obj
+
+    def save(self, **kwargs):
+        """Saves the dataset directory (``dataset_base.py:450``): config.json,
+        inferred_measurement_configs.json (+ per-measure metadata CSVs),
+        vocabulary_config.json, the three parquet dfs, and E.pkl attrs."""
+        save_dir = Path(self.config.save_dir)
+        save_dir.mkdir(parents=True, exist_ok=True)
+        do_overwrite = kwargs.get("do_overwrite", False)
+
+        self.config.to_json_file(save_dir / "config.json", do_overwrite=do_overwrite)
+
+        if self._is_fit:
+            metadata_dir = save_dir / "inferred_measurement_metadata"
+            for k, v in self.inferred_measurement_configs.items():
+                v.cache_measurement_metadata(metadata_dir / f"{k}.csv")
+
+            with open(save_dir / "inferred_measurement_configs.json", "w") as f:
+                json.dump({k: v.to_dict() for k, v in self.inferred_measurement_configs.items()}, f)
+
+            self.vocabulary_config.to_json_file(
+                save_dir / "vocabulary_config.json", do_overwrite=do_overwrite
+            )
+
+        attrs = {
+            "_is_fit": self._is_fit,
+            "split_subjects": self.split_subjects,
+            "subject_ids": self.subject_ids,
+            "event_types": self.event_types,
+            "n_events_per_subject": self.n_events_per_subject,
+        }
+        attrs_fp = save_dir / "E.pkl"
+        if attrs_fp.exists() and not do_overwrite:
+            raise FileExistsError(f"{attrs_fp} exists and do_overwrite is False!")
+        with open(attrs_fp, "wb") as f:
+            pickle.dump(attrs, f)
+
+        self._write_df(self.subjects_df, self.subjects_fp(save_dir), do_overwrite=do_overwrite)
+        self._write_df(self.events_df, self.events_fp(save_dir), do_overwrite=do_overwrite)
+        self._write_df(
+            self.dynamic_measurements_df,
+            self.dynamic_measurements_fp(save_dir),
+            do_overwrite=do_overwrite,
+        )
+
+    # ------------------------------------------------------------------ init
+    def __init__(
+        self,
+        config: DatasetConfig,
+        subjects_df: DF_T | None = None,
+        events_df: DF_T | None = None,
+        dynamic_measurements_df: DF_T | None = None,
+        input_schema: DatasetSchema | None = None,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+
+        if (
+            subjects_df is None or events_df is None or dynamic_measurements_df is None
+        ) and input_schema is None:
+            raise ValueError(
+                "Must set input_schema if subjects_df, events_df, or dynamic_measurements_df are None!"
+            )
+
+        if input_schema is None:
+            if subjects_df is None:
+                raise ValueError("Must set subjects_df if input_schema is None!")
+            if events_df is None:
+                raise ValueError("Must set events_df if input_schema is None!")
+            if dynamic_measurements_df is None:
+                raise ValueError("Must set dynamic_measurements_df if input_schema is None!")
+        else:
+            if subjects_df is not None:
+                raise ValueError("Can't set subjects_df if input_schema is not None!")
+            if events_df is not None:
+                raise ValueError("Can't set events_df if input_schema is not None!")
+            if dynamic_measurements_df is not None:
+                raise ValueError("Can't set dynamic_measurements_df if input_schema is not None!")
+
+            subjects_df, ID_map = self.build_subjects_dfs(input_schema.static)
+            subject_id_dtype = subjects_df["subject_id"].dtype
+
+            events_df, dynamic_measurements_df = self.build_event_and_measurement_dfs(
+                ID_map,
+                input_schema.static.subject_id_col,
+                subject_id_dtype,
+                input_schema.dynamic_by_df,
+            )
+
+        self.config = config
+        self._is_fit = False
+        self.inferred_measurement_configs: dict[str, MeasurementConfig] = {}
+
+        self._validate_and_set_initial_properties(subjects_df, events_df, dynamic_measurements_df)
+
+        self.split_subjects: dict[str, set] = {}
+
+    def _validate_and_set_initial_properties(self, subjects_df, events_df, dynamic_measurements_df):
+        """Validates inputs, shrinks dtypes, aggs+sorts events (``dataset_base.py:566``)."""
+        self.subject_ids = []
+        self.event_types = []
+        self.n_events_per_subject = {}
+
+        (
+            self.subjects_df,
+            self.events_df,
+            self.dynamic_measurements_df,
+        ) = self._validate_initial_dfs(subjects_df, events_df, dynamic_measurements_df)
+
+        if self.events_df is not None:
+            self._agg_by_time()
+            self._sort_events()
+        self._update_subject_event_properties()
+
+    # ------------------------------------------------------------- filtering
+    @TimeableMixin.TimeAs
+    def _filter_subjects(self):
+        """Drops subjects with too few events (``dataset_base.py:607``)."""
+        if self.config.min_events_per_subject is None:
+            return
+
+        subjects_to_keep = [
+            s for s, n in self.n_events_per_subject.items() if n >= self.config.min_events_per_subject
+        ]
+        self.subjects_df = self._filter_col_inclusion(self.subjects_df, {"subject_id": subjects_to_keep})
+        self.events_df = self._filter_col_inclusion(self.events_df, {"subject_id": subjects_to_keep})
+        self.dynamic_measurements_df = self._filter_col_inclusion(
+            self.dynamic_measurements_df, {"event_id": list(self.events_df["event_id"])}
+        )
+        self._update_subject_event_properties()
+
+    # ------------------------------------------------------------------ split
+    @SeedableMixin.WithSeed
+    @TimeableMixin.TimeAs
+    def split(
+        self,
+        split_fracs: Sequence[float],
+        split_names: Sequence[str] | None = None,
+    ):
+        """Randomly splits subjects into named splits (``dataset_base.py:642``)."""
+        split_fracs = list(split_fracs)
+
+        if min(split_fracs) <= 0 or max(split_fracs) > 1 or sum(split_fracs) > 1:
+            raise ValueError(
+                "split_fracs invalid! Want a list of numbers in (0, 1] that sums to no more than 1; got "
+                f"{repr(split_fracs)}"
+            )
+
+        if sum(split_fracs) < 1:
+            split_fracs.append(1 - sum(split_fracs))
+
+        if split_names is None:
+            if len(split_fracs) == 2:
+                split_names = ["train", "held_out"]
+            elif len(split_fracs) == 3:
+                split_names = ["train", "tuning", "held_out"]
+            else:
+                split_names = [f"split_{i}" for i in range(len(split_fracs))]
+        elif len(split_names) != len(split_fracs):
+            raise ValueError(
+                f"split_names and split_fracs must be the same length; got {len(split_names)} and "
+                f"{len(split_fracs)}"
+            )
+
+        # Shuffle names+fracs so rounding excess doesn't always hit the same split.
+        split_names_idx = np.random.permutation(len(split_names))
+        split_names = [split_names[i] for i in split_names_idx]
+        split_fracs = [split_fracs[i] for i in split_names_idx]
+
+        subjects = np.random.permutation(list(self.subject_ids))
+        split_lens = (np.array(split_fracs[:-1]) * len(subjects)).round().astype(int)
+        split_lens = np.append(split_lens, len(subjects) - split_lens.sum())
+
+        subjects_per_split = np.split(subjects, split_lens.cumsum())
+
+        self.split_subjects = {k: set(v.tolist()) for k, v in zip(split_names, subjects_per_split)}
+
+    # --------------------------------------------------------- split accessors
+    @property
+    def train_subjects_df(self) -> DF_T:
+        return self._filter_col_inclusion(self.subjects_df, {"subject_id": self.split_subjects["train"]})
+
+    @property
+    def tuning_subjects_df(self) -> DF_T:
+        return self._filter_col_inclusion(self.subjects_df, {"subject_id": self.split_subjects["tuning"]})
+
+    @property
+    def held_out_subjects_df(self) -> DF_T:
+        return self._filter_col_inclusion(
+            self.subjects_df, {"subject_id": self.split_subjects["held_out"]}
+        )
+
+    @property
+    def train_events_df(self) -> DF_T:
+        return self._filter_col_inclusion(self.events_df, {"subject_id": self.split_subjects["train"]})
+
+    @property
+    def tuning_events_df(self) -> DF_T:
+        return self._filter_col_inclusion(self.events_df, {"subject_id": self.split_subjects["tuning"]})
+
+    @property
+    def held_out_events_df(self) -> DF_T:
+        return self._filter_col_inclusion(self.events_df, {"subject_id": self.split_subjects["held_out"]})
+
+    @property
+    def train_dynamic_measurements_df(self) -> DF_T:
+        event_ids = self.train_events_df["event_id"]
+        return self._filter_col_inclusion(self.dynamic_measurements_df, {"event_id": list(event_ids)})
+
+    @property
+    def tuning_dynamic_measurements_df(self) -> DF_T:
+        event_ids = self.tuning_events_df["event_id"]
+        return self._filter_col_inclusion(self.dynamic_measurements_df, {"event_id": list(event_ids)})
+
+    @property
+    def held_out_dynamic_measurements_df(self) -> DF_T:
+        event_ids = self.held_out_events_df["event_id"]
+        return self._filter_col_inclusion(self.dynamic_measurements_df, {"event_id": list(event_ids)})
+
+    # ------------------------------------------------------------ preprocess
+    @TimeableMixin.TimeAs
+    def preprocess(self):
+        """filter → add time-dependent measures → fit → transform (``dataset_base.py:757``)."""
+        self._filter_subjects()
+        self._add_time_dependent_measurements()
+        self.fit_measurements()
+        self.transform_measurements()
+
+    @TimeableMixin.TimeAs
+    def _get_source_df(self, config: MeasurementConfig, do_only_train: bool = True):
+        """(source attr name, id col, df) for a measurement config (``dataset_base.py:780``)."""
+        if config.temporality == TemporalityType.DYNAMIC:
+            source_attr = "dynamic_measurements_df"
+            source_id = "measurement_id"
+            source_df = (
+                self.train_dynamic_measurements_df if do_only_train else self.dynamic_measurements_df
+            )
+        elif config.temporality == TemporalityType.STATIC:
+            source_attr = "subjects_df"
+            source_id = "subject_id"
+            source_df = self.train_subjects_df if do_only_train else self.subjects_df
+        elif config.temporality == TemporalityType.FUNCTIONAL_TIME_DEPENDENT:
+            source_attr = "events_df"
+            source_id = "event_id"
+            source_df = self.train_events_df if do_only_train else self.events_df
+        else:
+            raise ValueError(f"Called get_source_df on temporality type {config.temporality}!")
+        return source_attr, source_id, source_df
+
+    @TimeableMixin.TimeAs
+    def fit_measurements(self):
+        """Fits all preprocessing parameters over the train split (``dataset_base.py:819``)."""
+        self._is_fit = False
+
+        for measure, config in self.config.measurement_configs.items():
+            if config.is_dropped:
+                continue
+
+            self.inferred_measurement_configs[measure] = copy.deepcopy(config)
+            config = self.inferred_measurement_configs[measure]
+
+            _, _, source_df = self._get_source_df(config, do_only_train=True)
+
+            if measure not in source_df:
+                print(f"WARNING: Measure {measure} not found! Dropping...")
+                config.drop()
+                continue
+
+            total_possible, total_observed = self._total_possible_and_observed(
+                measure, config, source_df
+            )
+            source_df = self._filter_col_inclusion(source_df, {measure: True})
+
+            if total_possible == 0:
+                print(f"Found no possible events for {measure}!")
+                config.drop()
+                continue
+
+            config.observation_frequency = total_observed / total_possible
+
+            # Drop the column if observations occur too rarely.
+            if lt_count_or_proportion(
+                total_observed, self.config.min_valid_column_observations, total_possible
+            ):
+                config.drop()
+                continue
+
+            if config.is_numeric:
+                config.add_missing_mandatory_metadata_cols()
+                try:
+                    config.measurement_metadata = self._fit_measurement_metadata(
+                        measure, config, source_df
+                    )
+                except BaseException as e:
+                    raise ValueError(f"Fitting measurement metadata failed for measure {measure}!") from e
+
+            if config.vocabulary is None:
+                config.vocabulary = self._fit_vocabulary(measure, config, source_df)
+
+                # Eliminate observations that occur too rarely.
+                if config.vocabulary is not None:
+                    if self.config.min_valid_vocab_element_observations is not None:
+                        config.vocabulary.filter(
+                            len(source_df), self.config.min_valid_vocab_element_observations
+                        )
+
+                    # If all observations were eliminated, drop the column.
+                    if config.vocabulary.vocabulary == ["UNK"]:
+                        config.drop()
+
+        self._is_fit = True
+
+    @TimeableMixin.TimeAs
+    def transform_measurements(self):
+        """Transforms all splits via the fit parameters (``dataset_base.py:928``)."""
+        for measure, config in self.measurement_configs.items():
+            source_attr, id_col, source_df = self._get_source_df(config, do_only_train=False)
+
+            source_df = self._filter_col_inclusion(source_df, {measure: True})
+            updated_cols = [measure]
+
+            try:
+                if config.is_numeric:
+                    source_df = self._transform_numerical_measurement(measure, config, source_df)
+
+                    if config.modality == DataModality.MULTIVARIATE_REGRESSION:
+                        updated_cols.append(config.values_column)
+
+                    if self.config.outlier_detector_config is not None:
+                        updated_cols.append(f"{measure}_is_inlier")
+
+                if config.vocabulary is not None:
+                    source_df = self._transform_categorical_measurement(measure, config, source_df)
+
+            except BaseException as e:
+                raise ValueError(f"Transforming measurement failed for measure {measure}!") from e
+
+            self._update_attr_df(source_attr, id_col, source_df, updated_cols)
+
+    # ------------------------------------------------------------ properties
+    @property
+    def has_static_measurements(self):
+        return (self.subjects_df is not None) and any(
+            cfg.temporality == TemporalityType.STATIC for cfg in self.measurement_configs.values()
+        )
+
+    @property
+    def measurement_configs(self):
+        """All fit, non-dropped measurement configs (``dataset_base.py:1013``)."""
+        if not self._is_fit:
+            raise ValueError("Can't call measurement_configs if not yet fit!")
+        return {m: c for m, c in self.inferred_measurement_configs.items() if not c.is_dropped}
+
+    @property
+    def dynamic_numerical_columns(self):
+        return [
+            (k, cfg.values_column)
+            for k, cfg in self.measurement_configs.items()
+            if (cfg.is_numeric and cfg.temporality == TemporalityType.DYNAMIC)
+        ]
+
+    @property
+    def time_dependent_numerical_columns(self):
+        return [
+            k
+            for k, cfg in self.measurement_configs.items()
+            if (cfg.is_numeric and cfg.temporality == TemporalityType.FUNCTIONAL_TIME_DEPENDENT)
+        ]
+
+    @property
+    def measurement_idxmaps(self):
+        """Per-measurement vocab idxmaps; event_type first (``dataset_base.py:1043``)."""
+        idxmaps = {"event_type": {et: i for i, et in enumerate(self.event_types)}}
+        for m, config in self.measurement_configs.items():
+            if config.vocabulary is not None:
+                idxmaps[m] = config.vocabulary.idxmap
+        return idxmaps
+
+    @property
+    def measurement_vocabs(self):
+        vocabs = {"event_type": self.event_types}
+        for m, config in self.measurement_configs.items():
+            if config.vocabulary is not None:
+                vocabs[m] = config.vocabulary.vocabulary
+        return vocabs
+
+    @property
+    def unified_measurements_vocab(self) -> list[str]:
+        return ["event_type"] + list(sorted(self.measurement_configs.keys()))
+
+    @property
+    def unified_measurements_idxmap(self) -> dict[str, int]:
+        return {m: i + 1 for i, m in enumerate(self.unified_measurements_vocab)}
+
+    @property
+    def unified_vocabulary_offsets(self) -> dict[str, int]:
+        offsets, curr_offset = {}, 1
+        for m in self.unified_measurements_vocab:
+            offsets[m] = curr_offset
+            if m in self.measurement_vocabs:
+                curr_offset += len(self.measurement_vocabs[m])
+            else:
+                curr_offset += 1
+        return offsets
+
+    @property
+    def unified_vocabulary_idxmap(self) -> dict[str, dict[str, int]]:
+        idxmaps = {}
+        for m, offset in self.unified_vocabulary_offsets.items():
+            if m in self.measurement_idxmaps:
+                idxmaps[m] = {v: i + offset for v, i in self.measurement_idxmaps[m].items()}
+            else:
+                idxmaps[m] = {m: offset}
+        return idxmaps
+
+    @property
+    def vocabulary_config(self) -> VocabularyConfig:
+        """The unified `VocabularyConfig` for downstream DL (``dataset_base.py:1124``)."""
+        measurements_per_generative_mode = defaultdict(list)
+        measurements_per_generative_mode[DataModality.SINGLE_LABEL_CLASSIFICATION].append("event_type")
+        for m, cfg in self.measurement_configs.items():
+            if cfg.temporality != TemporalityType.DYNAMIC:
+                continue
+
+            measurements_per_generative_mode[cfg.modality].append(m)
+            if cfg.modality == DataModality.MULTIVARIATE_REGRESSION:
+                measurements_per_generative_mode[DataModality.MULTI_LABEL_CLASSIFICATION].append(m)
+
+        return VocabularyConfig(
+            vocab_sizes_by_measurement={
+                m: len(idxmap) for m, idxmap in self.measurement_idxmaps.items()
+            },
+            vocab_offsets_by_measurement=self.unified_vocabulary_offsets,
+            measurements_idxmap=self.unified_measurements_idxmap,
+            event_types_idxmap=self.unified_vocabulary_idxmap["event_type"],
+            measurements_per_generative_mode=dict(measurements_per_generative_mode),
+        )
+
+    # --------------------------------------------------------------- DL cache
+    @TimeableMixin.TimeAs
+    def cache_deep_learning_representation(
+        self, subjects_per_output_file: int | None = None, do_overwrite: bool = False
+    ):
+        """Writes ``DL_reps/{split}_{chunk}.parquet`` (``dataset_base.py:1062``)."""
+        DL_dir = Path(self.config.save_dir) / "DL_reps"
+        DL_dir.mkdir(exist_ok=True, parents=True)
+
+        if subjects_per_output_file is None:
+            subject_chunks = [None]
+        else:
+            subjects = np.random.permutation(list(self.subject_ids))
+            subject_chunks = np.array_split(
+                subjects,
+                np.arange(subjects_per_output_file, len(subjects), subjects_per_output_file),
+            )
+            subject_chunks = [list(c) for c in subject_chunks]
+
+        for chunk_idx, subjects_list in enumerate(subject_chunks):
+            cached_df = self.build_DL_cached_representation(subject_ids=subjects_list)
+
+            for split, subjects in self.split_subjects.items():
+                fp = DL_dir / f"{split}_{chunk_idx}.{self.DF_SAVE_FORMAT}"
+
+                split_cached_df = self._filter_col_inclusion(cached_df, {"subject_id": subjects})
+                self._write_df(split_cached_df, fp, do_overwrite=do_overwrite)

@@ -1,0 +1,7 @@
+"""Numerical pre-processor plugins (reference ``EventStream/data/preprocessing/``)."""
+
+from .preprocessor import Preprocessor
+from .standard_scaler import StandardScaler
+from .stddev_cutoff import StddevCutoffOutlierDetector
+
+__all__ = ["Preprocessor", "StandardScaler", "StddevCutoffOutlierDetector"]

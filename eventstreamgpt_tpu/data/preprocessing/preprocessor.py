@@ -1,0 +1,48 @@
+"""The plugin API for numerical-measurement pre-processors.
+
+Rebuild of ``/root/reference/EventStream/data/preprocessing/preprocessor.py:13``.
+The reference expresses fit/predict as unmaterialized Polars expressions;
+Polars is not available in this image, so the same contract is expressed over
+numpy arrays: ``fit`` maps a vector of raw observations to a params dict (one
+struct per vocabulary key, fit under a host-side groupby), and ``predict``
+maps values + per-row param columns to outputs, fully vectorized. Fit params
+live in the measurement-metadata dataframes as plain dicts, which keeps the
+reference's on-disk artifact format (dict-valued ``outlier_model`` /
+``normalizer`` cells) byte-compatible.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+import numpy as np
+
+
+class Preprocessor(ABC):
+    """sklearn-like fit/predict over numpy arrays, grouped by vocabulary key.
+
+    Subclasses declare ``params_schema`` (field names of the fit-params
+    struct), ``fit`` (observations → params dict), and ``predict`` (values +
+    per-row param arrays → outputs).
+    """
+
+    @classmethod
+    @abstractmethod
+    def params_schema(cls) -> dict[str, type]:
+        """Field names → dtypes of the fit-params struct."""
+        raise NotImplementedError("Subclass must implement abstract method")
+
+    @abstractmethod
+    def fit(self, column: np.ndarray) -> dict[str, float]:
+        """Fits the pre-processing model over raw observations ``column``."""
+        raise NotImplementedError("Subclass must implement abstract method")
+
+    @classmethod
+    @abstractmethod
+    def predict(cls, column: np.ndarray, model_params: dict[str, np.ndarray]) -> np.ndarray:
+        """Predicts for ``column`` given per-row fit parameters ``model_params``.
+
+        ``model_params`` maps each schema field to an array aligned with
+        ``column`` (rows inherit the params of their vocabulary key).
+        """
+        raise NotImplementedError("Subclass must implement abstract method")

@@ -1,0 +1,42 @@
+"""Pre-processor that normalizes data to have zero mean and unit variance.
+
+Rebuild of ``/root/reference/EventStream/data/preprocessing/standard_scaler.py:8``
+(numpy instead of Polars expressions; same params schema and semantics,
+including the sample standard deviation ``ddof=1``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .preprocessor import Preprocessor
+
+
+class StandardScaler(Preprocessor):
+    """Normalizes data to have zero mean and unit variance.
+
+    Examples:
+        >>> import numpy as np
+        >>> S = StandardScaler()
+        >>> params = S.fit(np.asarray([1., 2., 3., 4., 5.]))
+        >>> params["mean_"], round(params["std_"], 6)
+        (3.0, 1.581139)
+        >>> per_row = {k: np.full(5, v) for k, v in params.items()}
+        >>> np.round(S.predict(np.asarray([1., 2., 3., 4., 5.]), per_row), 6).tolist()
+        [-1.264911, -0.632456, 0.0, 0.632456, 1.264911]
+    """
+
+    @classmethod
+    def params_schema(cls) -> dict[str, type]:
+        return {"mean_": float, "std_": float}
+
+    def fit(self, column: np.ndarray) -> dict[str, float]:
+        column = np.asarray(column, dtype=np.float64)
+        return {
+            "mean_": float(np.mean(column)),
+            "std_": float(np.std(column, ddof=1)) if len(column) > 1 else float("nan"),
+        }
+
+    @classmethod
+    def predict(cls, column: np.ndarray, model_params: dict[str, np.ndarray]) -> np.ndarray:
+        return (np.asarray(column, dtype=np.float64) - model_params["mean_"]) / model_params["std_"]

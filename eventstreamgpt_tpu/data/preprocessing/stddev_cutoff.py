@@ -1,0 +1,48 @@
+"""Pre-processor flagging values beyond K standard deviations from the mean.
+
+Rebuild of ``/root/reference/EventStream/data/preprocessing/stddev_cutoff.py:9``
+(numpy instead of Polars expressions; same params schema and semantics,
+default cutoff 5.0, sample standard deviation ``ddof=1``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .preprocessor import Preprocessor
+
+
+class StddevCutoffOutlierDetector(Preprocessor):
+    """Flags data elements outside ``stddev_cutoff`` standard deviations.
+
+    Examples:
+        >>> import numpy as np
+        >>> S = StddevCutoffOutlierDetector(stddev_cutoff=1.0)
+        >>> params = S.fit(np.asarray([1., 2., 3., 4., 5.]))
+        >>> round(params["thresh_large_"], 6), round(params["thresh_small_"], 6)
+        (4.581139, 1.418861)
+        >>> per_row = {k: np.full(5, v) for k, v in params.items()}
+        >>> S.predict(np.asarray([1., 2., 3., 4., 5.]), per_row).tolist()
+        [True, False, False, False, True]
+    """
+
+    def __init__(self, stddev_cutoff: float = 5.0):
+        self.stddev_cutoff = stddev_cutoff
+
+    @classmethod
+    def params_schema(cls) -> dict[str, type]:
+        return {"thresh_large_": float, "thresh_small_": float}
+
+    def fit(self, column: np.ndarray) -> dict[str, float]:
+        column = np.asarray(column, dtype=np.float64)
+        mean = float(np.mean(column))
+        std = float(np.std(column, ddof=1)) if len(column) > 1 else float("nan")
+        return {
+            "thresh_large_": mean + self.stddev_cutoff * std,
+            "thresh_small_": mean - self.stddev_cutoff * std,
+        }
+
+    @classmethod
+    def predict(cls, column: np.ndarray, model_params: dict[str, np.ndarray]) -> np.ndarray:
+        column = np.asarray(column, dtype=np.float64)
+        return (column > model_params["thresh_large_"]) | (column < model_params["thresh_small_"])

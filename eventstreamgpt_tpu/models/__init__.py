@@ -6,9 +6,18 @@ from .config import (  # noqa: F401
     StructuredTransformerConfig,
     TimeToEventGenerationHeadType,
 )
+from .config import (  # noqa: F401
+    Averaging,
+    MetricCategories,
+    Metrics,
+    MetricsConfig,
+    OptimizationConfig,
+    Split,
+)
 from .embedding import (  # noqa: F401
     DataEmbeddingLayer,
     EmbeddingMode,
     MeasIndexGroupOptions,
     StaticEmbeddingMode,
 )
+from .fine_tuning_model import ESTForStreamClassification  # noqa: F401

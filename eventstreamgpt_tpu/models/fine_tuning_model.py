@@ -1,0 +1,120 @@
+"""A model for fine-tuning on stream (whole-sequence) classification tasks.
+
+Rebuild of ``/root/reference/EventStream/transformer/fine_tuning_model.py:15``
+(``ESTForStreamClassification``): CI or NA encoder (chosen by
+``structured_event_processing_mode``), a pooling step over event encodings
+(``cls`` / ``last`` / ``max`` / ``mean``, reference ``:71-81``), a logit head
+(1 output for binary, ``num_labels`` otherwise), and BCE/CE loss.
+
+Divergences, both deliberate:
+
+* ``last`` pooling selects the last *observed* event per subject via the
+  event mask rather than the raw final sequence position (the reference
+  indexes ``[:, :, -1]``, which reads padding when sequences are
+  right-padded; correct under its left-padding default but not in general).
+* The loss is averaged only over ``valid_mask`` rows so blanked wrap-around
+  fill subjects in short eval batches contribute nothing.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..data.types import EventStreamBatch
+from ..ops.tensor_ops import safe_masked_max, safe_weighted_avg
+from .config import StructuredEventProcessingMode, StructuredTransformerConfig
+from .model_output import StreamClassificationModelOutput
+from .transformer import (
+    ConditionallyIndependentPointProcessTransformer,
+    NestedAttentionPointProcessTransformer,
+)
+
+
+class ESTForStreamClassification(nn.Module):
+    """Encoder + pooling + logit head for stream classification."""
+
+    config: StructuredTransformerConfig
+
+    @property
+    def _uses_dep_graph(self) -> bool:
+        return (
+            self.config.structured_event_processing_mode
+            == StructuredEventProcessingMode.NESTED_ATTENTION
+        )
+
+    @property
+    def is_binary(self) -> bool:
+        return self.config.id2label == {0: False, 1: True}
+
+    def setup(self):
+        config = self.config
+        if self._uses_dep_graph:
+            self.encoder = NestedAttentionPointProcessTransformer(config)
+        else:
+            self.encoder = ConditionallyIndependentPointProcessTransformer(config)
+
+        self.pooling_method = (config.task_specific_params or {}).get("pooling_method", "last")
+
+        if self.is_binary:
+            if config.num_labels != 2:
+                raise ValueError(f"Binary task must have num_labels == 2; got {config.num_labels}")
+            self.logit_layer = nn.Dense(1)
+        else:
+            self.logit_layer = nn.Dense(config.num_labels)
+
+    def __call__(self, batch: EventStreamBatch, **kwargs) -> StreamClassificationModelOutput:
+        encoded = self.encoder(batch, **kwargs).last_hidden_state
+        # NA encodings are (B, L, G, H); the whole-event encoding is the last
+        # dep-graph element (reference ``fine_tuning_model.py:67``).
+        event_encoded = encoded[:, :, -1, :] if self._uses_dep_graph else encoded
+
+        event_mask = batch.event_mask
+        B, L, H = event_encoded.shape
+
+        if self.pooling_method == "cls":
+            stream_encoded = event_encoded[:, 0]
+        elif self.pooling_method == "last":
+            # Last observed event per subject (all-padding rows fall back to 0).
+            positions = jnp.arange(L)[None, :]
+            last_idx = jnp.max(jnp.where(event_mask, positions, 0), axis=1)
+            stream_encoded = event_encoded[jnp.arange(B), last_idx]
+        elif self.pooling_method == "max":
+            stream_encoded = safe_masked_max(
+                jnp.swapaxes(event_encoded, 1, 2), event_mask
+            )
+        elif self.pooling_method == "mean":
+            stream_encoded, _ = safe_weighted_avg(
+                jnp.swapaxes(event_encoded, 1, 2), event_mask
+            )
+        else:
+            raise ValueError(f"{self.pooling_method} is not a supported pooling method.")
+
+        logits = self.logit_layer(stream_encoded)
+        task = self.config.finetuning_task
+        labels = batch.stream_labels[task]
+
+        valid = (
+            batch.valid_mask.astype(jnp.float32)
+            if batch.valid_mask is not None
+            else jnp.ones((B,), dtype=jnp.float32)
+        )
+        denom = jnp.maximum(valid.sum(), 1.0)
+
+        if self.is_binary:
+            logits = logits[..., 0]
+            labels_f = labels.astype(jnp.float32)
+            per_ex = -(
+                labels_f * jax.nn.log_sigmoid(logits)
+                + (1 - labels_f) * jax.nn.log_sigmoid(-logits)
+            )
+            loss = (per_ex * valid).sum() / denom
+        else:
+            log_probs = jax.nn.log_softmax(logits, axis=-1)
+            per_ex = -jnp.take_along_axis(
+                log_probs, labels.astype(jnp.int32)[:, None], axis=-1
+            )[:, 0]
+            loss = (per_ex * valid).sum() / denom
+
+        return StreamClassificationModelOutput(loss=loss, preds=logits, labels=labels)

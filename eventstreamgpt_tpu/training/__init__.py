@@ -5,6 +5,12 @@ TPU-native replacement for the reference's Lightning modules
 """
 
 from .checkpoint import TrainCheckpointManager, load_pretrained, save_pretrained
+from .fine_tuning import (
+    FinetuneConfig,
+    StreamClassificationMetrics,
+    init_from_pretrained_encoder,
+)
+from .fine_tuning import train as finetune
 from .generative_metrics import GenerativeMetrics
 from .optimizer import build_optimizer, polynomial_decay_with_warmup
 from .pretrain import (
@@ -21,8 +27,12 @@ from .pretrain import (
 )
 
 __all__ = [
+    "FinetuneConfig",
     "GenerativeMetrics",
     "PretrainConfig",
+    "StreamClassificationMetrics",
+    "finetune",
+    "init_from_pretrained_encoder",
     "TrainCheckpointManager",
     "TrainState",
     "build_model",

@@ -1,0 +1,448 @@
+"""The fine-tuning harness for stream classification.
+
+Rebuild of ``/root/reference/EventStream/transformer/lightning_modules/fine_tuning.py``:
+
+* ``FinetuneConfig`` (``:270-381``): bootstraps from a pretrain ``save_dir``
+  — loads ``config.json`` + ``data_config.json``, applies overrides, sets
+  the task dataframe, and derives few-shot save dirs for train subsets.
+* the stream-classification metric sets (``:97-150``): binary /
+  multiclass / multilabel accuracy + AUROC + AUPRC.
+* ``train`` (``:384-514``): datasets → ``set_to_dataset`` → config dumps →
+  model (optionally warm-started from pretrained encoder weights) → fit with
+  tuning eval + early stopping → final tuning/held-out metric JSONs.
+
+The train loop itself reuses the pretraining harness machinery (mesh,
+jitted donated step, orbax checkpoints) — only the model/loss and metric
+collection differ.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import time
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import serialization
+
+from ..data.config import PytorchDatasetConfig
+from ..data.jax_dataset import JaxDataset
+from ..models.config import OptimizationConfig, Split, StructuredTransformerConfig
+from ..models.fine_tuning_model import ESTForStreamClassification
+from ..utils import config_dataclass
+from .checkpoint import TrainCheckpointManager, load_pretrained, save_pretrained
+from .metrics import (
+    BinaryAccuracy,
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    MeanMetric,
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAccuracy,
+    MultilabelAUROC,
+    MultilabelAveragePrecision,
+)
+from .optimizer import build_optimizer
+from .pretrain import TrainState, data_parallel_mesh, replicate, shard_batch
+
+# ---------------------------------------------------------------- metrics
+class StreamClassificationMetrics:
+    """Binary/multiclass/multilabel metric set (reference ``:97-150``)."""
+
+    def __init__(self, config: StructuredTransformerConfig, split: str, n_thresholds: int = 50):
+        self.split = split
+        self.loss = MeanMetric()
+        problem = config.problem_type
+        n = config.num_labels
+
+        if problem == "single_label_classification" and n > 2:
+            kw = {"num_classes": n}
+            self.metrics = {
+                "macro_AUROC": MulticlassAUROC(**kw, thresholds=n_thresholds, average="macro"),
+                "weighted_AUROC": MulticlassAUROC(**kw, thresholds=n_thresholds, average="weighted"),
+                "macro_accuracy": MulticlassAccuracy(**kw, average="macro"),
+                "weighted_accuracy": MulticlassAccuracy(**kw, average="weighted"),
+                "micro_accuracy": MulticlassAccuracy(**kw, average="micro"),
+                "macro_AUPRC": MulticlassAveragePrecision(
+                    **kw, thresholds=n_thresholds, average="macro"
+                ),
+                "weighted_AUPRC": MulticlassAveragePrecision(
+                    **kw, thresholds=n_thresholds, average="weighted"
+                ),
+            }
+        elif problem == "single_label_classification" and n == 2:
+            self.metrics = {
+                "AUROC": BinaryAUROC(thresholds=n_thresholds),
+                "accuracy": BinaryAccuracy(),
+                "AUPRC": BinaryAveragePrecision(thresholds=n_thresholds),
+            }
+        elif problem == "multi_label_classification":
+            kw = {"num_labels": n}
+            self.metrics = {
+                "macro_AUROC": MultilabelAUROC(**kw, thresholds=n_thresholds, average="macro"),
+                "weighted_AUROC": MultilabelAUROC(**kw, thresholds=n_thresholds, average="weighted"),
+                "micro_AUROC": MultilabelAUROC(**kw, thresholds=n_thresholds, average="micro"),
+                "macro_accuracy": MultilabelAccuracy(**kw, average="macro"),
+                "weighted_accuracy": MultilabelAccuracy(**kw, average="weighted"),
+                "micro_accuracy": MultilabelAccuracy(**kw, average="micro"),
+                "macro_AUPRC": MultilabelAveragePrecision(
+                    **kw, thresholds=n_thresholds, average="macro"
+                ),
+                "weighted_AUPRC": MultilabelAveragePrecision(
+                    **kw, thresholds=n_thresholds, average="weighted"
+                ),
+                "micro_AUPRC": MultilabelAveragePrecision(
+                    **kw, thresholds=n_thresholds, average="micro"
+                ),
+            }
+        else:
+            raise ValueError(f"{problem} not valid")
+
+    def update(self, out, n_valid: int | None = None, skip_metrics=()) -> None:
+        preds = np.asarray(out.preds)
+        labels = np.asarray(out.labels)
+        B = len(labels)
+        if n_valid is None:
+            n_valid = B
+        # Fill rows (beyond n_valid) are blanked subjects — drop them.
+        preds, labels = preds[:n_valid], labels[:n_valid]
+        self.loss.update(float(out.loss), weight=n_valid)
+        for name, metric in self.metrics.items():
+            if any(s in name for s in skip_metrics):
+                continue
+            metric.update(preds, labels)
+
+    def compute(self) -> dict[str, float]:
+        out = {f"{self.split}_loss": self.loss.compute()}
+        for name, metric in self.metrics.items():
+            v = metric.compute()
+            if not (isinstance(v, float) and np.isnan(v)):
+                out[f"{self.split}_{name}"] = v
+        return out
+
+
+# ----------------------------------------------------------------- config
+@config_dataclass
+class FinetuneConfig:
+    """Fine-tuning driver config (reference ``FinetuneConfig`` :270-381)."""
+
+    load_from_model_dir: str | Path | None = None
+    seed: int = 1
+
+    pretrained_weights_fp: str | Path | None = None
+    save_dir: str | Path | None = None
+
+    do_overwrite: bool = False
+
+    optimization_config: OptimizationConfig = dataclasses.field(default_factory=OptimizationConfig)
+
+    task_df_name: str | None = None
+
+    data_config_overrides: dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {
+            "subsequence_sampling_strategy": "to_end",
+            "seq_padding_side": "right",
+        }
+    )
+
+    trainer_config: dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {
+            "log_every_n_steps": 10,
+            "checkpoint_every_n_steps": 100,
+            "max_checkpoints_to_keep": 2,
+        }
+    )
+
+    task_specific_params: dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"pooling_method": "last", "num_samples": None}
+    )
+
+    config_overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    do_final_validation_on_metrics: bool = True
+
+    def __post_init__(self):
+        if isinstance(self.save_dir, str):
+            self.save_dir = Path(self.save_dir)
+
+        if self.load_from_model_dir is None:
+            self.data_config = None
+            self.config = None
+            return
+
+        self.load_from_model_dir = Path(self.load_from_model_dir)
+        if self.task_df_name is None:
+            raise ValueError("Missing mandatory parameter task_df_name!")
+
+        if self.pretrained_weights_fp is None:
+            self.pretrained_weights_fp = self.load_from_model_dir
+        if self.save_dir is None:
+            subset_size = self.data_config_overrides.get("train_subset_size", None)
+            if subset_size in (None, "FULL"):
+                self.save_dir = self.load_from_model_dir / "finetuning" / self.task_df_name
+            else:
+                if self.data_config_overrides.get("train_subset_seed", None) is None:
+                    self.data_config_overrides["train_subset_seed"] = int(
+                        random.randint(1, int(1e6))
+                    )
+                    print(
+                        f"WARNING: train_subset_size={subset_size} but seed is unset. Setting to "
+                        f"{self.data_config_overrides['train_subset_seed']}"
+                    )
+                self.save_dir = (
+                    self.load_from_model_dir
+                    / "finetuning"
+                    / f"subset_size_{subset_size}"
+                    / f"subset_seed_{self.data_config_overrides['train_subset_seed']}"
+                    / self.task_df_name
+                )
+
+        data_config_fp = self.load_from_model_dir / "data_config.json"
+        print(f"Loading data_config from {data_config_fp}")
+        self.data_config = PytorchDatasetConfig.from_json_file(data_config_fp)
+        self.data_config.task_df_name = self.task_df_name
+
+        for param, val in (self.data_config_overrides or {}).items():
+            if param == "task_df_name":
+                print(
+                    f"WARNING: task_df_name is set in data_config_overrides to {val}! "
+                    f"Original is {self.task_df_name}. Ignoring data_config_overrides..."
+                )
+                continue
+            print(f"Overwriting {param} in data_config from {getattr(self.data_config, param)} to {val}")
+            setattr(self.data_config, param, val)
+
+        config_fp = self.load_from_model_dir / "config.json"
+        print(f"Loading config from {config_fp}")
+        self.config = StructuredTransformerConfig.from_json_file(config_fp)
+
+        if self.task_specific_params is not None:
+            if self.config.task_specific_params is None:
+                self.config.task_specific_params = {}
+            self.config.task_specific_params.update(self.task_specific_params)
+
+        for param, val in (self.config_overrides or {}).items():
+            print(f"Overwriting {param} in config from {getattr(self.config, param)} to {val}")
+            setattr(self.config, param, val)
+
+
+# --------------------------------------------------------- pretrained graft
+def init_from_pretrained_encoder(
+    ft_params: Any, pretrained_dir: Path | str
+) -> Any:
+    """Grafts pretrained generative-model encoder weights into fresh
+    fine-tuning params (HF ``from_pretrained`` partial-load semantics: only
+    the encoder subtree transfers; pooling/logit layers stay fresh)."""
+    pretrained, _ = load_pretrained(pretrained_dir)
+    pre_encoder = pretrained["params"]["encoder"]
+    ft_sd = serialization.to_state_dict(ft_params)
+    ft_encoder = ft_sd["params"]["encoder"]
+
+    def graft(dst: dict, src: dict, path=""):
+        out = {}
+        for k, v in dst.items():
+            if k in src and isinstance(v, dict) and isinstance(src[k], dict):
+                out[k] = graft(v, src[k], f"{path}/{k}")
+            elif k in src and not isinstance(v, dict):
+                sv = np.asarray(src[k])
+                if sv.shape == np.asarray(v).shape:
+                    out[k] = sv
+                else:
+                    print(f"WARNING: shape mismatch at {path}/{k}; keeping fresh init")
+                    out[k] = v
+            else:
+                print(f"WARNING: {path}/{k} missing from pretrained weights; keeping fresh init")
+                out[k] = v
+        return out
+
+    ft_sd["params"]["encoder"] = graft(ft_encoder, pre_encoder)
+    return serialization.from_state_dict(ft_params, ft_sd)
+
+
+# ------------------------------------------------------------------ driver
+def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
+    """End-to-end fine-tuning (reference ``train`` :384-514)."""
+    np.random.seed(cfg.seed)
+    rng = jax.random.PRNGKey(cfg.seed)
+
+    train_pyd = JaxDataset(cfg.data_config, split="train")
+    tuning_pyd = JaxDataset(cfg.data_config, split="tuning")
+
+    config = cfg.config
+    data_config = cfg.data_config
+    oc = cfg.optimization_config
+
+    config.set_to_dataset(train_pyd)
+    oc.set_to_dataset(train_pyd)
+
+    save_dir = Path(cfg.save_dir)
+    is_main = jax.process_index() == 0
+    if is_main:
+        save_dir.mkdir(parents=True, exist_ok=True)
+        config_fp = save_dir / "config.json"
+        if config_fp.exists() and not cfg.do_overwrite:
+            raise FileExistsError(f"{config_fp} already exists!")
+        config.to_json_file(config_fp, do_overwrite=True)
+        data_config.to_json_file(save_dir / "data_config.json", do_overwrite=True)
+        oc.to_json_file(save_dir / "optimization_config.json", do_overwrite=True)
+
+    model = ESTForStreamClassification(config)
+    tx, lr_schedule = build_optimizer(oc)
+    mesh = data_parallel_mesh(oc.batch_size, oc.validation_batch_size)
+
+    if len(train_pyd) < oc.batch_size:
+        raise ValueError(
+            f"Train split has {len(train_pyd)} subjects but batch_size is {oc.batch_size}."
+        )
+    init_batch = next(train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed))
+    rng, init_rng = jax.random.split(rng)
+    params = model.init(init_rng, init_batch)
+    if cfg.pretrained_weights_fp is not None:
+        params = init_from_pretrained_encoder(params, cfg.pretrained_weights_fp)
+
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
+    state = replicate(state, mesh)
+
+    def train_step(state: TrainState, batch, rng):
+        dropout_rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(p):
+            return model.apply(p, batch, rngs={"dropout": dropout_rng}).loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        return (
+            TrainState(
+                step=state.step + 1,
+                params=optax.apply_updates(state.params, updates),
+                opt_state=new_opt,
+            ),
+            loss,
+        )
+
+    train_step = jax.jit(train_step, donate_argnums=(0,))
+    eval_step = jax.jit(lambda params, batch: model.apply(params, batch))
+
+    def evaluate(params, dataset, split) -> dict[str, float]:
+        metrics = StreamClassificationMetrics(config, split)
+        # seed=0 pins random subsequence crops: eval passes must be comparable.
+        for batch in dataset.batches(
+            oc.validation_batch_size, shuffle=False, drop_last=False, seed=0
+        ):
+            n_valid = (
+                int(np.asarray(batch.valid_mask).sum()) if batch.valid_mask is not None else None
+            )
+            out = eval_step(params, shard_batch(batch, mesh))
+            metrics.update(out, n_valid=n_valid)
+        return metrics.compute()
+
+    tc = dict(cfg.trainer_config or {})
+    log_every = int(tc.get("log_every_n_steps") or 10)
+    ckpt_every = int(tc.get("checkpoint_every_n_steps") or 100)
+    keep = int(tc.get("max_checkpoints_to_keep") or 2)
+    ckpt_mgr = TrainCheckpointManager(save_dir / "model_checkpoints", max_to_keep=keep)
+
+    log_fp = save_dir / "train_log.jsonl" if is_main else None
+
+    def log_record(rec: dict):
+        if log_fp is not None:
+            with open(log_fp, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+
+    accum = oc.gradient_accumulation or 1
+    best_tuning_loss = float("inf")
+    epochs_since_best = 0
+    global_step = 0
+    stop = False
+    tuning_metrics = None
+
+    for epoch in range(oc.max_epochs):
+        epoch_t0 = time.perf_counter()
+        window_losses = []
+        for batch in train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed + epoch):
+            state, loss = train_step(state, shard_batch(batch, mesh), rng)
+            global_step += 1
+            window_losses.append(loss)
+            if global_step % log_every == 0:
+                log_record(
+                    {
+                        "split": str(Split.TRAIN),
+                        "epoch": epoch,
+                        "step": global_step,
+                        "train_loss": float(jnp.mean(jnp.stack(window_losses))),
+                        "lr": float(lr_schedule(global_step // accum)),
+                    }
+                )
+                window_losses = []
+            if global_step % ckpt_every == 0:
+                ckpt_mgr.save(
+                    global_step,
+                    serialization.to_state_dict(jax.device_get(state)),
+                    metadata={"epoch": epoch, "epoch_complete": False},
+                )
+            if oc.max_training_steps is not None and global_step // accum >= oc.max_training_steps:
+                stop = True
+                break
+
+        tuning_metrics = evaluate(state.params, tuning_pyd, Split.TUNING)
+        tuning_loss = tuning_metrics.get("tuning_loss", float("nan"))
+        log_record(
+            {
+                "split": str(Split.TUNING),
+                "epoch": epoch,
+                "step": global_step,
+                **tuning_metrics,
+                "epoch_time_s": time.perf_counter() - epoch_t0,
+            }
+        )
+        print(f"finetune epoch {epoch}: tuning_loss={tuning_loss:.4f}")
+        ckpt_mgr.save(
+            global_step,
+            serialization.to_state_dict(jax.device_get(state)),
+            metadata={"epoch": epoch, "epoch_complete": True},
+        )
+
+        if np.isfinite(tuning_loss) and tuning_loss < best_tuning_loss - 1e-12:
+            best_tuning_loss = tuning_loss
+            epochs_since_best = 0
+        else:
+            epochs_since_best += 1
+            if oc.patience is not None and epochs_since_best >= max(oc.patience, 1):
+                print(f"Early stopping at epoch {epoch} (patience {oc.patience})")
+                break
+        if stop:
+            break
+
+    ckpt_mgr.wait_until_finished()
+    params_host = jax.device_get(state.params)
+    if is_main:
+        save_pretrained(save_dir, params_host)
+
+    if not cfg.do_final_validation_on_metrics:
+        ckpt_mgr.close()
+        return None, None, None
+
+    held_out_pyd = JaxDataset(cfg.data_config, split="held_out")
+    # The last epoch's tuning eval ran at these exact params with pinned eval
+    # crops, so reuse it rather than paying a second pass.
+    final_tuning = tuning_metrics
+    if final_tuning is None:
+        final_tuning = evaluate(state.params, tuning_pyd, Split.TUNING)
+    final_held_out = evaluate(state.params, held_out_pyd, Split.HELD_OUT)
+
+    if is_main:
+        print("Saving final metrics...")
+        with open(save_dir / "tuning_metrics.json", "w") as f:
+            json.dump(final_tuning, f)
+        with open(save_dir / "held_out_metrics.json", "w") as f:
+            json.dump(final_held_out, f)
+
+    ckpt_mgr.close()
+    return final_tuning.get("tuning_loss"), final_tuning, final_held_out

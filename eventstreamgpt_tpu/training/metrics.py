@@ -24,6 +24,9 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = [
+    "BinaryAccuracy",
+    "BinaryAUROC",
+    "BinaryAveragePrecision",
     "MeanMetric",
     "MulticlassAccuracy",
     "MultilabelAccuracy",
@@ -279,6 +282,45 @@ class MultilabelAveragePrecision(MultilabelAUROC):
             return self._average(per, self.average)
         finally:
             self.pos = saved
+
+
+class BinaryAccuracy:
+    """Binary accuracy over ``(N,)`` preds (logits or probs) and 0/1 labels."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.inner = MultilabelAccuracy(1, average="micro", threshold=threshold)
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        self.inner.update(np.asarray(preds).reshape(-1, 1), np.asarray(labels).reshape(-1, 1))
+
+    def compute(self) -> float:
+        return self.inner.compute()
+
+
+class BinaryAUROC:
+    """Binned AUROC over ``(N,)`` preds (logits or probs) and 0/1 labels."""
+
+    def __init__(self, thresholds: int = 50):
+        self.inner = MultilabelAUROC(1, thresholds=thresholds, average="macro")
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        self.inner.update(np.asarray(preds).reshape(-1, 1), np.asarray(labels).reshape(-1, 1))
+
+    def compute(self) -> float:
+        return self.inner.compute()
+
+
+class BinaryAveragePrecision:
+    """Binned average precision over ``(N,)`` preds and 0/1 labels."""
+
+    def __init__(self, thresholds: int = 50):
+        self.inner = MultilabelAveragePrecision(1, thresholds=thresholds, average="macro")
+
+    def update(self, preds: np.ndarray, labels: np.ndarray) -> None:
+        self.inner.update(np.asarray(preds).reshape(-1, 1), np.asarray(labels).reshape(-1, 1))
+
+    def compute(self) -> float:
+        return self.inner.compute()
 
 
 class MeanSquaredError:

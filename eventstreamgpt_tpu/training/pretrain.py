@@ -164,7 +164,10 @@ def evaluate(
     metrics = GenerativeMetrics(config, metrics_config, split=split)
     if key is None:
         key = jax.random.PRNGKey(0)
-    for batch in dataset.batches(batch_size, shuffle=False, drop_last=False):
+    # seed=0 pins the (otherwise random) subsequence crops so every eval pass
+    # scores identical data — epoch-to-epoch tuning losses must be comparable
+    # for early stopping, and the final validation must match the last epoch.
+    for batch in dataset.batches(batch_size, shuffle=False, drop_last=False, seed=0):
         n_valid = int(np.asarray(batch.valid_mask).sum()) if batch.valid_mask is not None else None
         if mesh is not None:
             batch = shard_batch(batch, mesh)

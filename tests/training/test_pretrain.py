@@ -233,6 +233,27 @@ class TestTrainDriver:
         tuning_recs = [r for r in records if r["split"] == "tuning"]
         assert len(tuning_recs) < 50
 
+    def test_eval_is_deterministic_across_passes(self, sample_dir):
+        """Random subsequence crops are pinned during eval, so repeated eval
+        passes at the same params produce identical losses (early stopping
+        and final-validation comparability)."""
+        from eventstreamgpt_tpu.training import evaluate, make_eval_step
+
+        config = StructuredTransformerConfig(**MODEL_KWARGS)
+        # max_seq_len 4 forces subsequence sampling on nearly every subject.
+        ds = JaxDataset(
+            PytorchDatasetConfig(save_dir=sample_dir, max_seq_len=4, min_seq_len=2), "tuning"
+        )
+        config.set_to_dataset(ds)
+        model = build_model(config)
+        batch = next(ds.batches(4, shuffle=False))
+        params = model.init(jax.random.PRNGKey(0), batch)
+        es = make_eval_step(model)
+        mc = MetricsConfig(do_skip_all_metrics=True)
+        m1 = evaluate(es, params, ds, 4, config, mc, "tuning", key=jax.random.PRNGKey(0))
+        m2 = evaluate(es, params, ds, 4, config, mc, "tuning", key=jax.random.PRNGKey(7))
+        assert m1["tuning_loss"] == pytest.approx(m2["tuning_loss"], rel=1e-6)
+
     def test_multi_device_mesh_is_used(self):
         mesh = data_parallel_mesh(4, 4)
         assert mesh.devices.size == min(4, len(jax.devices()))
