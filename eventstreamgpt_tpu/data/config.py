@@ -778,6 +778,13 @@ class MeasurementConfig(JSONableMixin):
                     fp = Path(mm)
                     if base_dir is not None and not fp.is_absolute():
                         fp = base_dir / fp
+                    elif base_dir is not None and not fp.exists():
+                        # Artifacts produced elsewhere carry absolute paths
+                        # from the producing machine; re-root them at the
+                        # local dataset directory's metadata cache.
+                        local = Path(base_dir) / "inferred_measurement_metadata" / fp.name
+                        if local.exists():
+                            fp = local
                     as_dict["_measurement_metadata"] = fp
                 case dict() if modality == str(DataModality.MULTIVARIATE_REGRESSION):
                     as_dict["_measurement_metadata"] = pd.DataFrame.from_dict(mm, orient="tight")

@@ -309,8 +309,11 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         inferred_fp = load_dir / "inferred_measurement_configs.json"
         if inferred_fp.is_file():
             with open(inferred_fp) as f:
+                # base_dir re-roots stale absolute metadata-CSV paths when the
+                # dataset directory was produced on another machine.
                 attrs["inferred_measurement_configs"] = {
-                    k: MeasurementConfig.from_dict(v) for k, v in json.load(f).items()
+                    k: MeasurementConfig.from_dict(v, base_dir=load_dir)
+                    for k, v in json.load(f).items()
                 }
 
         obj = cls.__new__(cls)

@@ -114,7 +114,10 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         self.vocabulary_config = VocabularyConfig.from_json_file(save_dir / "vocabulary_config.json")
 
         with open(save_dir / "inferred_measurement_configs.json") as f:
-            inferred = {k: MeasurementConfig.from_dict(v) for k, v in json.load(f).items()}
+            inferred = {
+                k: MeasurementConfig.from_dict(v, base_dir=save_dir)
+                for k, v in json.load(f).items()
+            }
         self.measurement_configs = {k: v for k, v in inferred.items() if not v.is_dropped}
 
         if config.task_df_name is not None:

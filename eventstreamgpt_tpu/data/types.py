@@ -243,6 +243,74 @@ class EventStreamBatch:
         """Converts all leaves to host numpy arrays (for labelers/writers)."""
         return jax.tree_util.tree_map(lambda x: np.asarray(x), self)
 
+    def convert_to_DL_DF(self):
+        """Converts the batch into the sparse deep-learning DataFrame format.
+
+        Reference: ``PytorchBatch.convert_to_DL_DF`` (``types.py:684``), with
+        pandas as the frame library. One row per subject; ragged columns are
+        de-padded lists (``time_delta``/``time`` per event; doubly-nested
+        ``dynamic_*`` per event per observation, with unobserved values as
+        None); scalar columns (``start_time``/``subject_id``/``start_idx``/
+        ``end_idx``) pass through.
+        """
+        import pandas as pd
+
+        b = self.to_numpy()
+        df: dict[str, list] = {
+            k: []
+            for k in (
+                "time_delta",
+                "time",
+                "static_indices",
+                "static_measurement_indices",
+                "dynamic_indices",
+                "dynamic_measurement_indices",
+                "dynamic_values",
+            )
+            if getattr(b, k) is not None
+        }
+
+        for k in ("start_time", "subject_id", "start_idx", "end_idx"):
+            if getattr(b, k) is not None:
+                df[k] = list(np.asarray(getattr(b, k)).tolist())
+
+        for i in range(b.batch_size):
+            if b.static_indices is not None:
+                idx, measurement_idx = de_pad(
+                    b.static_indices[i].tolist(), b.static_measurement_indices[i].tolist()
+                )
+                df["static_indices"].append(idx)
+                df["static_measurement_indices"].append(measurement_idx)
+
+            _, time_delta, time, idx, measurement_idx, vals, vals_mask = de_pad(
+                b.event_mask[i].tolist(),
+                None if b.time_delta is None else b.time_delta[i].tolist(),
+                None if b.time is None else b.time[i].tolist(),
+                b.dynamic_indices[i].tolist(),
+                b.dynamic_measurement_indices[i].tolist(),
+                b.dynamic_values[i].tolist(),
+                b.dynamic_values_mask[i].tolist(),
+            )
+
+            if time_delta is not None:
+                df["time_delta"].append(time_delta)
+            if time is not None:
+                df["time"].append(time)
+
+            names = ("dynamic_indices", "dynamic_measurement_indices", "dynamic_values")
+            for n in names:
+                df[n].append([])
+
+            for j in range(len(idx)):
+                de_padded = de_pad(idx[j], measurement_idx[j], vals[j], vals_mask[j])
+                for n, v in zip(names[:-1], de_padded[:-2]):
+                    df[n][i].append(v)
+                df["dynamic_values"][i].append(
+                    [v if m else None for v, m in zip(*de_padded[-2:])]
+                )
+
+        return pd.DataFrame(df)
+
     def with_fields(self, **updates: Any) -> "EventStreamBatch":
         """Returns a copy with the given fields replaced."""
         return self.replace(**updates)

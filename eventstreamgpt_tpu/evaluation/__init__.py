@@ -1,0 +1,25 @@
+"""Evaluation layer: trajectory generation at scale + model-free MCF/CRPS.
+
+Rebuild of ``/root/reference/EventStream/evaluation/``.
+"""
+
+from .general_generative_evaluation import GenerateConfig, generate_trajectories
+from .mcf_evaluation import (
+    align_time_and_eval_predicates,
+    crps,
+    eval_range,
+    get_aligned_timestamps,
+    get_MCF,
+    get_MCF_coordinates,
+)
+
+__all__ = [
+    "GenerateConfig",
+    "align_time_and_eval_predicates",
+    "crps",
+    "eval_range",
+    "generate_trajectories",
+    "get_MCF",
+    "get_MCF_coordinates",
+    "get_aligned_timestamps",
+]

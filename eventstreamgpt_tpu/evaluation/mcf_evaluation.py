@@ -1,0 +1,302 @@
+"""Longitudinal, MCF-based evaluation over measurement predicates.
+
+Rebuild of ``/root/reference/EventStream/evaluation/MCF_evaluation.py`` on
+numpy + pandas (the reference uses numpy + polars; the numpy math is
+identical, the frame ops are re-expressed). Model-free: compares generated
+trajectories to true continuations via empirical CRPS and mean-cumulative-
+function estimation over boolean measurement predicates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+RANGE_T = tuple  # (lower, upper), each None | float | (float, inclusive_bool)
+
+__all__ = [
+    "crps",
+    "eval_range",
+    "align_time_and_eval_predicates",
+    "get_aligned_timestamps",
+    "get_MCF",
+    "get_MCF_coordinates",
+]
+
+
+def crps(samples: np.ndarray, true: np.ndarray) -> np.ndarray:
+    """Computes the empirical Continuous Ranked Probability Score (CRPS).
+
+    Reference ``MCF_evaluation.py:9`` (itself after pyro's
+    ``crps_empirical``; Gneiting & Raftery 2007). ``samples`` has independent
+    draws on axis 0; NaNs mark missing/censored draws or observations.
+
+    Examples:
+        >>> import numpy as np
+        >>> crps(np.array([[-2]]), np.array([0]))
+        array([2])
+        >>> crps(np.array([[-2], [np.nan], [np.nan], [1], [2]]), np.array([0]))
+        array([0.77777778])
+        >>> crps(np.array([[-2], [-1], [0], [1], [2]]), np.array([0]))
+        array([0.4])
+        >>> true = np.array([-2, 0, -2, np.nan])
+        >>> samples = np.array([
+        ...     [-1, 1,  -1,      -1],
+        ...     [1, -2,   1,       1],
+        ...     [2, -20,  np.nan,  2],
+        ...     [0,  10,  0,       0],
+        ...     [3,  1,   3,       3],
+        ...     [1,  1,   1,       1]
+        ... ])
+        >>> crps(samples, true)
+        array([2.27777778, 1.41666667, 2.08      ,        nan])
+        >>> crps(np.array([-2, -1, 0, 1, 2]), true)
+        Traceback (most recent call last):
+            ...
+        ValueError: The shape of true (4,) must match that of samples (5,) after the 1st dimension.
+    """
+    if true.shape != samples.shape[1:]:
+        raise ValueError(
+            f"The shape of true {true.shape} must match that of samples {samples.shape} after "
+            "the 1st dimension."
+        )
+
+    if samples.shape[0] == 1:
+        return np.abs(samples[0] - true)
+
+    n_samples = (~np.isnan(samples)).sum(0)
+
+    samples = np.sort(samples, axis=0)
+    diff = samples[1:] - samples[:-1]
+
+    counting_up = np.ones_like(samples).cumsum(0)[:-1]
+    lhs = counting_up - (np.isnan(samples).sum(0))
+    lhs = np.where(lhs > 0, lhs, np.nan)
+
+    rhs = np.where(~np.isnan(lhs), np.flip(counting_up, 0), np.nan)
+    weight = np.flip(lhs * rhs, 0)
+
+    abs_error = np.nanmean(np.abs(true - samples), 0)
+    return abs_error - (np.nansum(diff * weight, axis=0) / n_samples**2)
+
+
+def eval_range(rng: bool | RANGE_T, val: np.ndarray) -> np.ndarray:
+    """True where ``val`` satisfies the range spec (reference ``:271``).
+
+    ``rng`` is either a bool (returned directly) or ``(lower, upper)`` with
+    each bound None (unbounded), a number (exclusive), or ``(number, bool)``
+    (the bool selects inclusivity). NaN values never satisfy numeric bounds.
+
+    Examples:
+        >>> import numpy as np
+        >>> vals = np.array([0.1, 1.0, 3.0, np.nan])
+        >>> eval_range(True, vals)
+        array([ True,  True,  True,  True])
+        >>> eval_range((1, 2), vals)
+        array([False, False, False, False])
+        >>> eval_range(((1, True), 2), vals)
+        array([False,  True, False, False])
+        >>> eval_range((None, 2), vals)
+        array([ True,  True, False, False])
+        >>> eval_range((1, None), vals)
+        array([False, False,  True, False])
+    """
+    val = np.asarray(val, dtype=np.float64)
+    if isinstance(rng, bool):
+        return np.full(val.shape, rng)
+
+    lower_bound, upper_bound = rng
+    with np.errstate(invalid="ignore"):
+        out = np.ones(val.shape, dtype=bool)
+        if lower_bound is not None:
+            if isinstance(lower_bound, tuple):
+                bound, incl = lower_bound
+                out &= (val >= bound) if incl else (val > bound)
+            else:
+                out &= val > lower_bound
+        if upper_bound is not None:
+            if isinstance(upper_bound, tuple):
+                bound, incl = upper_bound
+                out &= (val <= bound) if incl else (val < bound)
+            else:
+                out &= val < upper_bound
+        out &= ~np.isnan(val)
+    if lower_bound is None and upper_bound is None:
+        return np.full(val.shape, True)
+    return out
+
+
+def align_time_and_eval_predicates(
+    df: pd.DataFrame, measurement_predicates: dict[int, bool | RANGE_T]
+) -> pd.DataFrame:
+    """Re-zeroes times at ``align_time`` and evaluates per-event predicates.
+
+    Reference ``:344-435``. ``df`` must have ``subject_id``, ``time`` (list
+    per subject), ``dynamic_indices`` / ``dynamic_values`` (list-of-lists),
+    and scalar ``align_time``. Returns one row per subject with list columns
+    ``time`` and ``pred_{idx}`` (bool per event: any observation at that
+    event satisfies the predicate), sorted by subject and time, duplicate
+    times merged with any().
+    """
+    records = []
+    for _, row in df.iterrows():
+        align = float(row["align_time"])
+        per_time: dict[float, dict[int, bool]] = {}
+        for t, idxs, vals in zip(row["time"], row["dynamic_indices"], row["dynamic_values"]):
+            t = float(t) - align
+            slot = per_time.setdefault(t, {i: False for i in measurement_predicates})
+            idxs = np.asarray(list(idxs), dtype=np.int64) if len(list(idxs)) else np.zeros(0, np.int64)
+            vals_arr = np.asarray(
+                [np.nan if v is None else float(v) for v in vals], dtype=np.float64
+            ) if len(list(vals)) else np.zeros(0, np.float64)
+            for pred_idx, rng in measurement_predicates.items():
+                hit = (idxs == pred_idx) & eval_range(rng, vals_arr)
+                slot[pred_idx] = slot[pred_idx] or bool(hit.any())
+        times = sorted(per_time)
+        records.append(
+            {
+                "subject_id": row["subject_id"],
+                "time": times,
+                **{
+                    f"pred_{idx}": [per_time[t][idx] for t in times]
+                    for idx in measurement_predicates
+                },
+            }
+        )
+    out = pd.DataFrame(records).sort_values("subject_id", kind="stable").reset_index(drop=True)
+    return out
+
+
+def get_aligned_timestamps(
+    control_T, *sample_Ts, n_timestamps: int | None = None
+) -> list[float]:
+    """Union of all observed (aligned) times, optionally downsampled.
+
+    Reference ``:228-268``. Inputs are iterables of per-subject time lists
+    (None entries skipped).
+    """
+
+    def get_Ts(series) -> set:
+        out = set()
+        for row in series:
+            if row is None:
+                continue
+            out.update(float(t) for t in row)
+        return out
+
+    all_Ts = get_Ts(control_T)
+    for T in sample_Ts:
+        all_Ts |= get_Ts(T)
+    all_Ts = list(all_Ts)
+    if n_timestamps is not None and len(all_Ts) > n_timestamps:
+        all_Ts = list(np.random.choice(all_Ts, size=n_timestamps, replace=False))
+    return sorted(all_Ts)
+
+
+def get_MCF(
+    aligned_Ts: list[float], MCF_cols: list[str], *dfs: pd.DataFrame
+) -> tuple[np.ndarray, np.ndarray]:
+    """Population censor masks + cumulative predicate incidence deltas.
+
+    Reference ``:93-225``. Returns:
+
+    1. bool ``(len(dfs), n_subjects, len(aligned_Ts)+1)``: subject has any
+       data at/after each aligned time (leading column always True).
+    2. float ``(len(dfs), n_subjects, len(aligned_Ts)+1, len(MCF_cols))``:
+       new predicate incidences per inter-timestamp bucket; NaN where the
+       subject has no events in a bucket that other subjects populate.
+    """
+    n_buckets = len(aligned_Ts) + 1
+    censor_slices, MCF_slices = [], []
+    for df in dfs:
+        df = df.sort_values("subject_id", kind="stable")
+        n_subj = len(df)
+        max_time = np.asarray([max(row) if len(row) else -np.inf for row in df["time"]])
+        censor = np.concatenate(
+            [
+                np.ones((n_subj, 1), dtype=bool),
+                max_time[:, None] >= np.asarray(aligned_Ts)[None, :],
+            ],
+            axis=1,
+        )
+        censor_slices.append(censor)
+
+        # Buckets: searchsorted of each event time into aligned_Ts; bucket
+        # j collects events in (aligned_Ts[j-1], aligned_Ts[j]].
+        per_col = np.full((n_subj, n_buckets, len(MCF_cols)), np.nan)
+        buckets_populated = np.zeros((n_subj, n_buckets), dtype=bool)
+        all_populated = np.zeros(n_buckets, dtype=bool)
+        for i, (_, row) in enumerate(df.iterrows()):
+            times = np.asarray(row["time"], dtype=np.float64)
+            b = np.searchsorted(np.asarray(aligned_Ts), times, side="left")
+            buckets_populated[i, b] = True
+            all_populated[b] = True
+            for k, col in enumerate(MCF_cols):
+                flags = np.asarray(row[col], dtype=np.float64)
+                per_col[i, :, k] = np.bincount(b, weights=flags, minlength=n_buckets)
+        # Reference pivot semantics: a bucket column exists if any subject
+        # populates it; cells for subjects without events there are NaN;
+        # entirely-unpopulated buckets are 0 for everyone.
+        for j in range(n_buckets):
+            if not all_populated[j]:
+                per_col[:, j, :] = 0.0
+            else:
+                per_col[~buckets_populated[:, j], j, :] = np.nan
+        MCF_slices.append(per_col)
+
+    return np.stack(censor_slices, axis=0), np.stack(MCF_slices, axis=0)
+
+
+def get_MCF_coordinates(
+    control_df: pd.DataFrame,
+    sample_dfs: list[pd.DataFrame],
+    measurement_predicates: dict[int, bool | RANGE_T],
+    n_timestamps: int | None = None,
+):
+    """Aligned per-subject MCF coordinates for control vs samples.
+
+    Reference ``:438-594``. ``control_df`` needs ``control_align_idx`` (the
+    event index that is time zero); sample dfs align via the control's align
+    time, joined on subject_id.
+
+    Returns ``(subject_ids, aligned_Ts, dynamic_indices, control_censor_mask,
+    control_MCF, sample_censor_mask, sample_MCF)``.
+    """
+    control_df = control_df.copy()
+    control_df["align_time"] = [
+        float(row["time"][int(row["control_align_idx"])]) for _, row in control_df.iterrows()
+    ]
+
+    align_times = control_df.set_index("subject_id")["align_time"]
+    aligned_sample_dfs = []
+    for df in sample_dfs:
+        joined = df[df["subject_id"].isin(align_times.index)].copy()
+        joined["align_time"] = joined["subject_id"].map(align_times)
+        aligned_sample_dfs.append(
+            align_time_and_eval_predicates(joined, measurement_predicates)
+        )
+
+    control_aligned = align_time_and_eval_predicates(control_df, measurement_predicates)
+
+    subject_ids = control_aligned["subject_id"].tolist()
+
+    aligned_timestamps = get_aligned_timestamps(
+        control_aligned["time"],
+        *[df["time"] for df in aligned_sample_dfs],
+        n_timestamps=n_timestamps,
+    )
+
+    dynamic_indices = list(measurement_predicates.keys())
+    MCF_cols = [f"pred_{i}" for i in dynamic_indices]
+    control_censor_mask, control_MCF = get_MCF(aligned_timestamps, MCF_cols, control_aligned)
+    sample_censor_mask, sample_MCF = get_MCF(aligned_timestamps, MCF_cols, *aligned_sample_dfs)
+
+    return (
+        subject_ids,
+        aligned_timestamps,
+        dynamic_indices,
+        control_censor_mask,
+        control_MCF,
+        sample_censor_mask,
+        sample_MCF,
+    )

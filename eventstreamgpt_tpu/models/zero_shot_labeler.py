@@ -1,0 +1,49 @@
+"""The zero-shot labeler functor API.
+
+Rebuild of ``/root/reference/EventStream/transformer/zero_shot_labeler.py:9``:
+users subclass ``Labeler`` in a file named ``{task_df_name}_labeler.py`` inside
+the dataset's ``task_dfs/`` directory (class name ``TaskLabeler``); the
+zero-shot evaluator imports it dynamically and applies it to generated
+batches. Labels are produced on host (numpy) — labeling is I/O-light string
+logic over generated indices, not accelerator work.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..data.types import EventStreamBatch
+from .config import StructuredTransformerConfig
+
+
+class Labeler(abc.ABC):
+    """Base class for zero-shot labeler functors.
+
+    Attributes:
+        config: The model config — vocabulary sizes, offsets, idxmaps needed
+            to decode generated batch indices into task labels.
+    """
+
+    def __init__(self, config: StructuredTransformerConfig):
+        self.config = config
+
+    @abc.abstractmethod
+    def __call__(
+        self, batch: EventStreamBatch, input_seq_len: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Labels each generated sequence.
+
+        Args:
+            batch: the completed batch: ``batch[:, :input_seq_len]`` is the
+                original input, ``batch[:, input_seq_len:]`` the generated
+                continuation.
+            input_seq_len: events in the original input (incl. padding).
+
+        Returns:
+            A ``(batch_size, num_labels)`` one-hot label array and a
+            ``(batch_size,)`` bool array marking samples whose label could
+            NOT be determined from the generated events (True = unpredictable).
+        """
+        raise NotImplementedError("Must be overwritten by a subclass!")

@@ -1,0 +1,185 @@
+"""Zero-shot classification via generation ("generative prompting").
+
+Rebuild of
+``/root/reference/EventStream/transformer/lightning_modules/zero_shot_evaluator.py``:
+for each eval batch, generate ``num_samples`` continuations per subject with
+the pretrained generative model, apply a user ``Labeler`` to each generated
+sequence, and average the resulting one-hot labels over samples (masked by
+the labeler's per-sample predictability flag) into empirical class
+probabilities (``get_generative_predictions`` :213-276). Subjects whose
+samples were all unpredictable are dropped; ``frac_unpredictable`` is
+tracked per split (:198-203). The driver (``zero_shot_evaluation`` :304-391)
+bootstraps from a pretrain ``save_dir`` via `FinetuneConfig`, dynamically
+imports ``task_dfs/{task}_labeler.py`` (class ``TaskLabeler``), and writes
+``zero_shot_{split}_metrics.json``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+from pathlib import Path
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+
+from ..data.jax_dataset import JaxDataset
+from ..generation import generate
+from ..models.config import Split, StructuredTransformerConfig
+from ..models.zero_shot_labeler import Labeler
+from .checkpoint import load_pretrained
+from .fine_tuning import FinetuneConfig, StreamClassificationMetrics
+from .pretrain import build_model
+
+
+def import_class_from_file(module_path: Path | str, class_name: str):
+    """Dynamic import (reference ``zero_shot_evaluator.py:297``)."""
+    spec = importlib.util.spec_from_file_location(class_name, module_path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return getattr(module, class_name)
+
+
+def get_generative_predictions(
+    model,
+    params,
+    config: StructuredTransformerConfig,
+    labeling_function: Labeler,
+    batch,
+    key: jax.Array,
+    num_samples: int,
+    max_new_events: int,
+    use_cache: bool = True,
+):
+    """Generates, labels, and averages into empirical label probabilities.
+
+    Reference ``:213-276``. Returns ``(StreamClassificationModelOutput-like,
+    frac_unpredictable per original subject)``; subjects with no predictable
+    samples are dropped from preds/labels.
+    """
+    B = batch.batch_size
+    generated = generate(
+        model,
+        params,
+        batch,
+        config,
+        key,
+        max_new_events=max_new_events,
+        num_return_sequences=num_samples,
+        use_cache=use_cache,
+    )
+    empirical_labels, labels_unpredicted = labeling_function(
+        generated, input_seq_len=batch.sequence_length
+    )
+
+    num_labels = config.num_labels
+    empirical_labels = np.asarray(empirical_labels, dtype=np.float64).reshape(
+        B, num_samples, num_labels
+    )
+    labels_unpredicted = np.asarray(labels_unpredicted, dtype=bool).reshape(B, num_samples)
+
+    weight = (~labels_unpredicted)[:, :, None].astype(np.float64)
+    denom = weight.sum(axis=1)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        probs = np.where(denom > 0, (empirical_labels * weight).sum(axis=1) / denom, 0.0)
+    frac_unpredictable = labels_unpredicted.mean(axis=1)
+
+    predictable = frac_unpredictable != 1.0
+    # Fill rows in short eval batches are invalid regardless of the labeler.
+    if batch.valid_mask is not None:
+        predictable = predictable & np.asarray(batch.valid_mask)
+
+    probs = probs[predictable]
+    true_labels = np.asarray(batch.stream_labels[config.finetuning_task])[predictable]
+
+    if config.id2label == {0: False, 1: True}:
+        probs = probs[:, 1]
+        true_labels = true_labels.astype(np.int64)
+
+    output = SimpleNamespace(loss=float("nan"), preds=probs, labels=true_labels)
+    return output, frac_unpredictable[
+        np.asarray(batch.valid_mask) if batch.valid_mask is not None else slice(None)
+    ]
+
+
+def zero_shot_evaluation(
+    cfg: FinetuneConfig, num_samples: int | None = None
+) -> tuple[dict, dict]:
+    """Runs zero-shot evaluation over tuning + held-out (reference ``:304-391``)."""
+    np.random.seed(cfg.seed)
+    key = jax.random.PRNGKey(cfg.seed)
+
+    tuning_pyd = JaxDataset(cfg.data_config, split="tuning")
+    held_out_pyd = JaxDataset(cfg.data_config, split="held_out")
+
+    config = cfg.config
+    batch_size = cfg.optimization_config.validation_batch_size
+
+    # set_to_dataset must not shrink the generation budget or perturb the fit
+    # TTE statistics (reference ``:317-323``).
+    orig_max_seq_len = config.max_seq_len
+    orig_mean = config.mean_log_inter_event_time_min
+    orig_std = config.std_log_inter_event_time_min
+    config.set_to_dataset(tuning_pyd)
+    config.max_seq_len = orig_max_seq_len
+    config.mean_log_inter_event_time_min = orig_mean
+    config.std_log_inter_event_time_min = orig_std
+
+    labeler_fp = Path(cfg.data_config.save_dir) / "task_dfs" / f"{cfg.task_df_name}_labeler.py"
+    labeler_cls = import_class_from_file(labeler_fp, "TaskLabeler")
+    labeling_function = labeler_cls(config=config)
+
+    if num_samples is None:
+        num_samples = (config.task_specific_params or {}).get("num_samples") or 1
+    max_new_events = config.max_seq_len - tuning_pyd.max_seq_len
+    if max_new_events <= 0:
+        raise ValueError(
+            f"config.max_seq_len ({config.max_seq_len}) must exceed the dataset's max_seq_len "
+            f"({tuning_pyd.max_seq_len}) to leave room for generation."
+        )
+
+    model = build_model(config)
+    if cfg.pretrained_weights_fp is None:
+        raise ValueError("pretrained_weights_fp must be specified")
+    init_batch = next(tuning_pyd.batches(min(batch_size, len(tuning_pyd)), shuffle=False))
+    template = model.init(jax.random.PRNGKey(0), init_batch)
+    params, _ = load_pretrained(cfg.pretrained_weights_fp, params_template=template)
+
+    results = {}
+    for split, dataset in ((Split.TUNING, tuning_pyd), (Split.HELD_OUT, held_out_pyd)):
+        metrics = StreamClassificationMetrics(config, split)
+        frac_unpredictable: list[np.ndarray] = []
+        for batch in dataset.batches(batch_size, shuffle=False, drop_last=False, seed=0):
+            key, sub = jax.random.split(key)
+            out, frac = get_generative_predictions(
+                model,
+                params,
+                config,
+                labeling_function,
+                batch,
+                sub,
+                num_samples=num_samples,
+                max_new_events=max_new_events,
+            )
+            if len(out.labels):
+                metrics.update(out)
+            frac_unpredictable.append(frac)
+        result = metrics.compute()
+        result.pop(f"{split}_loss", None)  # zero-shot has no loss
+        if frac_unpredictable:
+            result[f"{split}_frac_unpredictable"] = float(
+                np.concatenate(frac_unpredictable).mean()
+            )
+        results[str(split)] = result
+
+    save_dir = Path(cfg.save_dir)
+    if jax.process_index() == 0:
+        print("Saving final metrics...")
+        save_dir.mkdir(parents=True, exist_ok=True)
+        with open(save_dir / "zero_shot_tuning_metrics.json", "w") as f:
+            json.dump(results[str(Split.TUNING)], f)
+        with open(save_dir / "zero_shot_held_out_metrics.json", "w") as f:
+            json.dump(results[str(Split.HELD_OUT)], f)
+
+    return results[str(Split.TUNING)], results[str(Split.HELD_OUT)]

@@ -251,15 +251,38 @@ def load_config(
     if isinstance(config_cls, str):
         config_cls = CONFIG_STORE[config_cls]
 
-    merged: dict[str, Any] = {}
+    # Seed with *declared* dataclass defaults so ${...} interpolations can
+    # reference them even when neither YAML nor CLI set the referenced key.
+    # Nested dataclasses seed from their declared field defaults rather than
+    # an instantiated object: __post_init__-derived values (e.g.
+    # OptimizationConfig.end_lr computed from init_lr) must not be baked in,
+    # or overriding one of their inputs later would conflict (hydra's
+    # ConfigStore has the same declared-defaults semantics).
+    def declared_defaults(cls: type) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for f in dataclasses.fields(cls):
+            if f.default is not dataclasses.MISSING:
+                v = f.default
+            elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
+                v = f.default_factory()
+            else:
+                continue
+            if dataclasses.is_dataclass(v) and not isinstance(v, type):
+                # A factory instance indistinguishable from the plain default
+                # seeds from declared field defaults (so __post_init__-derived
+                # values don't get baked in); a factory that customized any
+                # field keeps its instance state verbatim — structure() will
+                # re-run __post_init__ and re-derive consistently.
+                try:
+                    is_plain_default = unstructure(type(v)()) == unstructure(v)
+                except TypeError:
+                    is_plain_default = False
+                out[f.name] = declared_defaults(type(v)) if is_plain_default else unstructure(v)
+            else:
+                out[f.name] = unstructure(v)
+        return out
 
-    # Seed with dataclass defaults so ${...} interpolations can reference them
-    # even when neither YAML nor CLI set the referenced key.
-    for f in dataclasses.fields(config_cls):
-        if f.default is not dataclasses.MISSING:
-            merged[f.name] = unstructure(f.default)
-        elif f.default_factory is not dataclasses.MISSING:  # type: ignore[misc]
-            merged[f.name] = unstructure(f.default_factory())
+    merged: dict[str, Any] = declared_defaults(config_cls)
 
     def merge(dst: dict, src: dict) -> None:
         for k, v in src.items():

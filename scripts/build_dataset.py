@@ -1,0 +1,426 @@
+"""Builds a processed event-stream dataset from a compact YAML spec.
+
+Rebuild of ``/root/reference/scripts/build_dataset.py`` — the same YAML
+dialect (``inputs:`` sources + ``measurements:`` by temporality/modality; see
+``/root/reference/sample_data/dataset.yaml``) translated into
+``DatasetSchema`` / ``InputDFSchema`` / ``MeasurementConfig`` objects, then
+``Dataset`` → ``split`` → ``preprocess`` → ``save`` →
+``cache_deep_learning_representation``. Hydra is replaced by the repo's
+``utils.config_tool`` (``${...}`` interpolation + ``key=value`` overrides);
+the hydra ``defaults:`` list is resolved against the shipped ``configs/``
+directory.
+
+Usage::
+
+    python -m scripts.build_dataset --config sample_data/dataset.yaml \
+        save_dir=./processed cohort_name=sample
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+from collections import defaultdict
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+from eventstreamgpt_tpu.data import (
+    Dataset,
+    DatasetConfig,
+    DatasetSchema,
+    InputDataType,
+    InputDFSchema,
+    InputDFType,
+    MeasurementConfig,
+    TemporalityType,
+)
+from eventstreamgpt_tpu.data.dataset_pandas import Query
+from eventstreamgpt_tpu.data.types import DataModality
+from eventstreamgpt_tpu.utils.config_tool import parse_overrides, resolve_interpolations
+
+CONFIGS_DIR = Path(__file__).resolve().parent.parent / "configs"
+
+
+def _singular(name: str) -> str:
+    """Best-effort singularization for default event types (the reference uses
+    ``inflect``, which is not installed here): strips a plural 's' with the
+    usual '-ies'/'-ses' special cases."""
+    if name.endswith("ies"):
+        return name[:-3] + "y"
+    if name.endswith("ses"):
+        return name[:-2]
+    if name.endswith("s") and not name.endswith("ss"):
+        return name[:-1]
+    return name
+
+
+def add_to_container(key: str, val: Any, container: dict[str, Any]) -> None:
+    """Adds key→val, erroring on conflicting re-specification (reference ``:66``)."""
+    if key in container:
+        if container[key] == val:
+            print(f"WARNING: {key} is specified twice with value {val}.")
+        else:
+            raise ValueError(f"{key} is specified twice ({container[key]} v. {val})")
+    else:
+        container[key] = val
+
+
+def load_yaml_with_defaults(yaml_fp: Path | str, configs_dir: Path = CONFIGS_DIR) -> dict:
+    """Loads a YAML config, resolving its hydra-style ``defaults:`` list.
+
+    Supported entries: a bare config name (merged from
+    ``configs/<name>.yaml``, recursively), ``{group: name}`` (merged into key
+    ``group`` from ``configs/<group>/<name>.yaml``), and ``_self_`` (the
+    file's own values take precedence from that point).
+    """
+    with open(yaml_fp) as f:
+        raw = yaml.safe_load(f) or {}
+
+    defaults = raw.pop("defaults", [])
+    raw.pop("hydra", None)
+    merged: dict[str, Any] = {}
+
+    def merge(dst: dict, src: dict) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    for entry in defaults:
+        if entry == "_self_":
+            merge(merged, raw)
+            raw = {}
+        elif isinstance(entry, str):
+            merge(merged, load_yaml_with_defaults(configs_dir / f"{entry}.yaml", configs_dir))
+        elif isinstance(entry, dict):
+            for group, name in entry.items():
+                group_cfg = load_yaml_with_defaults(
+                    configs_dir / group / f"{name}.yaml", configs_dir
+                )
+                merged[group] = group_cfg
+        else:
+            raise ValueError(f"Can't resolve defaults entry {entry!r}")
+    merge(merged, raw)
+    return merged
+
+
+def build_dataset(cfg: dict[str, Any]) -> Dataset:
+    """Translates the YAML dict into configs and runs the ETL (reference ``:76-360``)."""
+    cfg = dict(cfg)
+
+    # 1. Build measurement_configs and track input schemas.
+    subject_id_col = cfg.pop("subject_id_col")
+    measurements_by_temporality = cfg.pop("measurements")
+
+    static_sources: dict[str, dict] = defaultdict(dict)
+    dynamic_sources: dict[str, dict] = defaultdict(dict)
+    measurement_configs: dict[str, MeasurementConfig] = {}
+
+    time_dep_measurements = measurements_by_temporality.pop(
+        str(TemporalityType.FUNCTIONAL_TIME_DEPENDENT), {}
+    )
+
+    for temporality, measurements_by_modality in measurements_by_temporality.items():
+        schema_source = (
+            static_sources if temporality == str(TemporalityType.STATIC) else dynamic_sources
+        )
+        for modality, measurements_by_source in (measurements_by_modality or {}).items():
+            if not measurements_by_source:
+                continue
+            for source_name, measurements in measurements_by_source.items():
+                data_schema = schema_source[source_name]
+
+                if isinstance(measurements, str):
+                    measurements = [measurements]
+                for m in measurements:
+                    measurement_config_kwargs: dict[str, Any] = {
+                        "name": m,
+                        "temporality": temporality,
+                        "modality": modality,
+                    }
+                    if isinstance(m, dict):
+                        m_dict = dict(m)
+                        if m_dict.get("values_column", None):
+                            values_column = m_dict.pop("values_column")
+                            m = [m_dict.pop("name"), values_column]
+                        else:
+                            m = m_dict.pop("name")
+                        measurement_config_kwargs.update(m_dict)
+
+                    if isinstance(m, str) and modality == str(DataModality.UNIVARIATE_REGRESSION):
+                        add_to_container(m, InputDataType.FLOAT, data_schema)
+                    elif (
+                        isinstance(m, (list, tuple))
+                        and len(m) == 2
+                        and modality == str(DataModality.MULTIVARIATE_REGRESSION)
+                    ):
+                        m, v = m
+                        add_to_container(m, InputDataType.CATEGORICAL, data_schema)
+                        add_to_container(v, InputDataType.FLOAT, data_schema)
+                        measurement_config_kwargs["values_column"] = v
+                        measurement_config_kwargs["name"] = m
+                    elif isinstance(m, str) and modality in (
+                        str(DataModality.SINGLE_LABEL_CLASSIFICATION),
+                        str(DataModality.MULTI_LABEL_CLASSIFICATION),
+                    ):
+                        add_to_container(m, InputDataType.CATEGORICAL, data_schema)
+                    else:
+                        raise ValueError(
+                            f"{m}, {modality} invalid! Must be in {DataModality.values()}!"
+                        )
+
+                    if m in measurement_configs:
+                        old = {
+                            k: v
+                            for k, v in measurement_configs[m].to_dict().items()
+                            if v is not None
+                        }
+                        if old != measurement_config_kwargs:
+                            raise ValueError(
+                                f"{m} differs across input sources!\n{old}\nvs.\n"
+                                f"{measurement_config_kwargs}"
+                            )
+                    else:
+                        measurement_configs[m] = MeasurementConfig(**measurement_config_kwargs)
+
+    if len(static_sources) > 1:
+        raise NotImplementedError(
+            f"Currently, only 1 static source can be specified -- you have {static_sources}"
+        )
+
+    static_key = list(static_sources.keys())[0]
+    static_col_schema = static_sources[static_key]
+
+    for m, config in (time_dep_measurements or {}).items():
+        config = dict(config)
+        if not isinstance(m, str):
+            raise ValueError(f"{m} must be a string for time-dep measurement!")
+        functor_class = config.pop("functor")
+        functor_kwargs = config.pop("kwargs", {})
+
+        measurement_config_kwargs = {
+            "name": m,
+            "temporality": TemporalityType.FUNCTIONAL_TIME_DEPENDENT,
+            "functor": MeasurementConfig.FUNCTORS[functor_class](**functor_kwargs),
+        }
+
+        for in_col, in_fmt in (config.pop("necessary_static_measurements", None) or {}).items():
+            if isinstance(in_fmt, (list, tuple)) and in_fmt[0] == "timestamp":
+                schema_val = (InputDataType.TIMESTAMP, in_fmt[1])
+            else:
+                schema_val = in_fmt
+            if in_col in static_col_schema and static_col_schema[in_col] != schema_val:
+                raise ValueError(
+                    f"Schema Collision! {in_col}, {schema_val} v. {static_col_schema[in_col]}"
+                )
+            static_col_schema[in_col] = schema_val
+
+        measurement_configs[m] = MeasurementConfig(**measurement_config_kwargs)
+
+    # 2. Build DatasetSchema.
+    connection_uri = cfg.pop("connection_uri", None)
+    cfg.pop("raw_data_dir", None)
+
+    def build_schema(
+        col_schema: dict[str, Any],
+        source_schema: dict[str, Any],
+        schema_name: str,
+        **extra_kwargs,
+    ) -> InputDFSchema:
+        input_schema_kwargs: dict[str, Any] = {}
+
+        if "query" in source_schema:
+            if "input_df" in source_schema:
+                raise ValueError(
+                    f"Can't specify both query {source_schema['query']} "
+                    f"and input_df {source_schema['input_df']} at once!"
+                )
+            q = source_schema["query"]
+            if isinstance(q, (str, list)):
+                if not connection_uri:
+                    raise ValueError("If providing a query string, must provide a connection_uri!")
+                input_schema_kwargs["input_df"] = Query(
+                    query=tuple(q) if isinstance(q, list) else q, connection_uri=connection_uri
+                )
+            elif isinstance(q, dict):
+                q = dict(q)
+                q.setdefault("connection_uri", connection_uri)
+                input_schema_kwargs["input_df"] = Query(**q)
+            else:
+                raise ValueError(f"Cannot parse query {q}!")
+        elif "input_df" in source_schema:
+            input_schema_kwargs["input_df"] = source_schema["input_df"]
+        else:
+            raise ValueError("Must specify either a query or an input dataframe!")
+
+        for param in (
+            "start_ts_col",
+            "end_ts_col",
+            "ts_col",
+            "event_type",
+            "start_ts_format",
+            "end_ts_format",
+            "ts_format",
+        ):
+            if param in source_schema:
+                input_schema_kwargs[param] = source_schema[param]
+
+        if source_schema.get("start_ts_col", None):
+            input_schema_kwargs["type"] = InputDFType.RANGE
+        elif source_schema.get("ts_col", None):
+            input_schema_kwargs["type"] = InputDFType.EVENT
+        else:
+            input_schema_kwargs["type"] = InputDFType.STATIC
+
+        if input_schema_kwargs["type"] != InputDFType.STATIC and "event_type" not in input_schema_kwargs:
+            input_schema_kwargs["event_type"] = _singular(schema_name).upper()
+
+        if (
+            input_schema_kwargs["type"] == InputDFType.RANGE
+            and isinstance(input_schema_kwargs.get("event_type"), list)
+        ):
+            input_schema_kwargs["event_type"] = tuple(input_schema_kwargs["event_type"])
+
+        cols_covered = []
+        any_schemas_present = False
+        for n, cols_n in (
+            ("start_data_schema", "start_columns"),
+            ("end_data_schema", "end_columns"),
+            ("data_schema", "columns"),
+        ):
+            if cols_n not in source_schema:
+                continue
+            cols = source_schema[cols_n]
+            data_schema: dict[str, Any] = {}
+
+            et = source_schema.get("event_type", None)
+            et_list = et if isinstance(et, list) else ([et] if isinstance(et, str) else [])
+            for et_entry in et_list:
+                if isinstance(et_entry, str) and et_entry.startswith("COL:"):
+                    event_type_col = et_entry[len("COL:"):]
+                    data_schema[event_type_col] = (event_type_col, InputDataType.CATEGORICAL)
+
+            if isinstance(cols, dict):
+                cols = [list(t) for t in cols.items()]
+
+            for col in cols:
+                if (
+                    isinstance(col, (list, tuple))
+                    and len(col) == 2
+                    and col[1] in col_schema
+                ):
+                    schema_key = col[0]
+                    schema_val = (col[1], col_schema[col[1]])
+                elif isinstance(col, str) and col in col_schema:
+                    schema_key = col
+                    schema_val = (col, col_schema[col])
+                else:
+                    raise ValueError(f"{col} unprocessable! Col schema: {col_schema}")
+
+                cols_covered.append(schema_val[0])
+                add_to_container(schema_key, schema_val, data_schema)
+            input_schema_kwargs[n] = data_schema
+            any_schemas_present = True
+
+        if not any_schemas_present and (len(col_schema) > len(cols_covered)):
+            input_schema_kwargs["data_schema"] = {}
+
+        for col, dt in col_schema.items():
+            if col in cols_covered:
+                continue
+            for schema in ("start_data_schema", "end_data_schema", "data_schema"):
+                if schema in input_schema_kwargs:
+                    input_schema_kwargs[schema][col] = dt
+
+        must_have = source_schema.get("must_have", None)
+        if must_have is None:
+            pass
+        elif isinstance(must_have, list):
+            input_schema_kwargs["must_have"] = must_have
+        elif isinstance(must_have, dict):
+            mh = []
+            for k, v in must_have.items():
+                if v is True:
+                    mh.append(k)
+                elif isinstance(v, list):
+                    mh.append((k, v))
+                else:
+                    raise ValueError(f"{v} invalid for `must_have`")
+            input_schema_kwargs["must_have"] = mh
+
+        return InputDFSchema(**input_schema_kwargs, **extra_kwargs)
+
+    inputs = dict(cfg.pop("inputs"))
+    dataset_schema = DatasetSchema(
+        static=build_schema(
+            col_schema=static_col_schema,
+            source_schema=inputs.pop(static_key),
+            subject_id_col=subject_id_col,
+            schema_name=static_key,
+        ),
+        dynamic=[
+            build_schema(
+                col_schema=dynamic_sources.get(dynamic_key, {}),
+                source_schema=source_schema,
+                schema_name=dynamic_key,
+            )
+            for dynamic_key, source_schema in inputs.items()
+        ],
+    )
+
+    # 3. Build DatasetConfig + run the pipeline.
+    split = cfg.pop("split", (0.8, 0.1))
+    seed = cfg.pop("seed", 1)
+    do_overwrite = cfg.pop("do_overwrite", False)
+    cfg.pop("cohort_name", None)
+    DL_chunk_size = cfg.pop("DL_chunk_size", 20000)
+
+    valid_config_kwargs = {f.name for f in dataclasses.fields(DatasetConfig)}
+    extra_kwargs = {k: v for k, v in cfg.items() if k not in valid_config_kwargs}
+    config_kwargs = {k: v for k, v in cfg.items() if k in valid_config_kwargs}
+
+    if extra_kwargs:
+        print(f"Omitting {extra_kwargs} from config!")
+
+    config = DatasetConfig(measurement_configs=measurement_configs, **config_kwargs)
+
+    if config.save_dir is not None:
+        Path(config.save_dir).mkdir(parents=True, exist_ok=True)
+
+    ESD = Dataset(config=config, input_schema=dataset_schema)
+    ESD.split(split, seed=seed)
+    ESD.preprocess()
+    ESD.save(do_overwrite=do_overwrite)
+    ESD.cache_deep_learning_representation(DL_chunk_size, do_overwrite=do_overwrite)
+    return ESD
+
+
+def main(argv: list[str] | None = None) -> Dataset:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    yaml_fp = None
+    if "--config" in argv:
+        i = argv.index("--config")
+        yaml_fp = argv[i + 1]
+        del argv[i : i + 2]
+    if yaml_fp is None:
+        yaml_fp = CONFIGS_DIR / "dataset_base.yaml"
+
+    cfg = load_yaml_with_defaults(yaml_fp)
+
+    def merge(dst: dict, src: dict) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    merge(cfg, parse_overrides(argv))
+    cfg = resolve_interpolations(cfg)
+    return build_dataset(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""Hyperparameter sweep launcher (local random search).
+
+Rebuild of ``/root/reference/scripts/launch_wandb_hp_sweep.py``: the same
+sweep-config dialect (nested parameter groups with ``value`` / ``values`` /
+``min``+``max`` [+ ``distribution: log_uniform_values``] leaves, collapsed to
+hydra dotted-override syntax by ``collapse_cfg``), but executed locally —
+this environment has no W&B service, so instead of registering a remote
+bayes sweep the launcher samples ``n_trials`` random configurations and
+either writes the pretrain command list (default) or runs them in-process
+(``--run``). The sweep objective name (``tuning_loss``) is preserved so
+result ranking works the same way.
+
+Usage::
+
+    python -m scripts.launch_hp_sweep --config configs/hyperparameter_sweep_base.yaml \
+        n_trials=10 sweep_dir=./exp/sweep
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+import sys
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from eventstreamgpt_tpu.utils.config_tool import parse_overrides, resolve_interpolations
+
+from .build_dataset import CONFIGS_DIR, load_yaml_with_defaults
+
+WANDB_SWEEP_KEYS = {"value", "values", "min", "max", "distribution"}
+
+
+def collapse_cfg(k: str, v: dict[str, Any]) -> dict[str, Any]:
+    """Collapses nested parameter groups to dotted keys (reference ``:24-71``).
+
+    Examples:
+        >>> collapse_cfg("bar", {"values": "vals"})
+        {'bar': {'values': 'vals'}}
+        >>> collapse_cfg("foo", {"bar": {"baz": {"values": "vals"}}, "biz": {"max": "MX"}})
+        {'foo.bar.baz': {'values': 'vals'}, 'foo.biz': {'max': 'MX'}}
+        >>> collapse_cfg("foo", {"bar": {"value": None}})
+        {}
+        >>> collapse_cfg("foo", None)
+        Traceback (most recent call last):
+            ...
+        TypeError: Misconfigured @ foo: None (<class 'NoneType'>) is not a dict!
+    """
+    if type(v) is not dict:
+        raise TypeError(f"Misconfigured @ {k}: {v} ({type(v)}) is not a dict!")
+    if WANDB_SWEEP_KEYS.intersection(v.keys()):
+        if set(v.keys()) == {"value"} and v["value"] is None:
+            return {}
+        return {k: v}
+
+    out: dict[str, Any] = {}
+    for kk, vv in v.items():
+        out.update(collapse_cfg(f"{k}.{kk}" if k else kk, vv))
+    return out
+
+
+def sample_param(spec: dict[str, Any], rng: np.random.Generator) -> Any:
+    """Draws one value from a W&B-dialect parameter spec."""
+    if "value" in spec:
+        v = spec["value"]
+        return None if v == "null" else v
+    if "values" in spec:
+        return spec["values"][int(rng.integers(len(spec["values"])))]
+    lo, hi = spec["min"], spec["max"]
+    if spec.get("distribution") == "log_uniform_values":
+        return float(np.exp(rng.uniform(np.log(lo), np.log(hi))))
+    if isinstance(lo, int) and isinstance(hi, int):
+        return int(rng.integers(lo, hi + 1))
+    return float(rng.uniform(lo, hi))
+
+
+def sample_trial(parameters: dict[str, dict], rng: np.random.Generator) -> dict[str, Any]:
+    """One random configuration as a dotted-key → value mapping."""
+    return {k: sample_param(spec, rng) for k, spec in parameters.items()}
+
+
+def main(argv: list[str] | None = None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    yaml_fp = None
+    do_run = False
+    if "--config" in argv:
+        i = argv.index("--config")
+        yaml_fp = argv[i + 1]
+        del argv[i : i + 2]
+    if "--run" in argv:
+        do_run = True
+        argv.remove("--run")
+    if yaml_fp is None:
+        yaml_fp = CONFIGS_DIR / "hyperparameter_sweep_base.yaml"
+
+    cfg = load_yaml_with_defaults(yaml_fp)
+
+    def merge(dst: dict, src: dict) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    merge(cfg, parse_overrides(argv))
+    cfg = resolve_interpolations(cfg)
+
+    n_trials = int(cfg.get("n_trials", 10))
+    seed = int(cfg.get("seed", 1))
+    sweep_dir = Path(cfg.get("sweep_dir", "./sweep"))
+    sweep_dir.mkdir(parents=True, exist_ok=True)
+
+    parameters = collapse_cfg("", cfg["parameters"])
+    rng = np.random.default_rng(seed)
+
+    commands = []
+    trials = []
+    for t in range(n_trials):
+        trial = sample_trial(parameters, rng)
+        trial["save_dir"] = str(sweep_dir / f"trial_{t}")
+        trials.append(trial)
+        args = " ".join(f"{k}={shlex.quote(json.dumps(v) if not isinstance(v, str) else v)}"
+                        for k, v in trial.items() if v is not None)
+        commands.append(f"python -m scripts.pretrain {args}")
+
+    (sweep_dir / "sweep_trials.json").write_text(json.dumps(trials, indent=2))
+    (sweep_dir / "sweep_commands.sh").write_text("\n".join(commands) + "\n")
+    print(f"Wrote {n_trials} trial commands to {sweep_dir / 'sweep_commands.sh'}")
+
+    if do_run:
+        from .pretrain import main as pretrain_main
+
+        results = []
+        for t, trial in enumerate(trials):
+            print(f"--- sweep trial {t} ---")
+            trial_args = [f"{k}={json.dumps(v) if not isinstance(v, str) else v}"
+                          for k, v in trial.items() if v is not None]
+            tuning_loss, _, _ = pretrain_main(trial_args)
+            results.append({"trial": t, cfg["metric"]["name"]: tuning_loss, **trial})
+        results.sort(key=lambda r: r.get(cfg["metric"]["name"]) or float("inf"))
+        (sweep_dir / "sweep_results.json").write_text(json.dumps(results, indent=2))
+        print(f"Best trial: {results[0]}")
+        return results
+
+    return commands
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Prepares config dirs + command lists for pretraining-subset experiments.
+
+Rebuild of ``/root/reference/scripts/prepare_pretrain_subsets.py``: given an
+initial pretrain run directory (holding ``pretrain_config.yaml``), generates
+per-subset-size × per-seed run directories with modified pretrain configs and
+writes shell command lists for pretraining, few-shot fine-tuning, zero-shot
+evaluation, and embedding extraction over those runs.
+
+Usage::
+
+    python -m scripts.prepare_pretrain_subsets \
+        initial_model_path=./exp/pretrain subset_sizes='[100, 1000]' \
+        experiment_name=subset_experiments seeds=2
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+import yaml
+
+from eventstreamgpt_tpu.utils.config_tool import parse_overrides, resolve_interpolations
+
+from .build_dataset import CONFIGS_DIR, load_yaml_with_defaults
+
+
+def main(argv: list[str] | None = None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    yaml_fp = None
+    if "--config" in argv:
+        i = argv.index("--config")
+        yaml_fp = argv[i + 1]
+        del argv[i : i + 2]
+    if yaml_fp is None:
+        yaml_fp = CONFIGS_DIR / "pretrain_subsets_base.yaml"
+
+    cfg = load_yaml_with_defaults(yaml_fp)
+
+    def merge(dst: dict, src: dict) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    merge(cfg, parse_overrides(argv))
+    cfg = resolve_interpolations(cfg)
+
+    initial_model_path = Path(cfg["initial_model_path"])
+    initial_config_path = initial_model_path / "pretrain_config.yaml"
+    if not initial_config_path.is_file():
+        raise FileNotFoundError(f"{initial_config_path} does not exist!")
+
+    subset_sizes = cfg["subset_sizes"]
+    if not isinstance(subset_sizes, list):
+        raise TypeError(f"subset_sizes must be a list, got {subset_sizes}!")
+
+    seeds = cfg["seeds"]
+    if isinstance(seeds, int):
+        seeds = [seeds for _ in subset_sizes]
+    elif isinstance(seeds, list) and len(seeds) == len(subset_sizes):
+        pass
+    elif isinstance(seeds, dict) and all(s in seeds for s in subset_sizes):
+        seeds = [seeds[s] for s in subset_sizes]
+    else:
+        raise TypeError(
+            f"seeds must be an int or a list/dict matching {subset_sizes}, got {seeds}!"
+        )
+
+    with open(initial_config_path) as f:
+        initial_config = yaml.safe_load(f)
+
+    experiment_dir = cfg.get("experiment_dir") or initial_config.get("experiment_dir")
+    experiment_dir = Path(experiment_dir)
+    runs_dir = experiment_dir / cfg["experiment_name"]
+    runs_dir.mkdir(parents=True, exist_ok=True)
+
+    ft_tasks = (cfg.get("few_shot_commands") or {}).get("fine_tuning_task_names", [])
+    zs_tasks = (cfg.get("zero_shot_commands") or {}).get("fine_tuning_task_names", [])
+    emb_tasks = (cfg.get("get_embeddings_commands") or {}).get("fine_tuning_task_names", [])
+
+    commands = defaultdict(list)
+    for n_seeds, subset_size in zip(seeds, subset_sizes):
+        for seed in range(n_seeds):
+            seed_runs_dir = runs_dir / f"subset_{subset_size}" / f"seed_{seed}"
+            seed_runs_dir.mkdir(parents=True, exist_ok=True)
+
+            if cfg.get("do_include_PT_commands", True):
+                new_config = copy.deepcopy(initial_config)
+                new_config["experiment_dir"] = str(experiment_dir)
+                new_config.setdefault("data_config", {})["train_subset_size"] = subset_size
+                new_config["data_config"]["train_subset_seed"] = seed
+                new_config["save_dir"] = str(seed_runs_dir)
+
+                new_config_path = seed_runs_dir / "pretrain_config_source.yaml"
+                with open(new_config_path, "w") as f:
+                    yaml.safe_dump(new_config, f)
+
+                commands["pretrain"].append(
+                    f"python -m scripts.pretrain --config {new_config_path}"
+                )
+
+            for task in ft_tasks:
+                for ft_subset in (cfg["few_shot_commands"].get("fine_tuning_subset_sizes") or ["FULL"]):
+                    commands["finetune"].append(
+                        f"python -m scripts.finetune load_from_model_dir={seed_runs_dir} "
+                        f"task_df_name={task} "
+                        f"data_config_overrides.train_subset_size={ft_subset}"
+                    )
+            for task in zs_tasks:
+                num_samples = (cfg["zero_shot_commands"] or {}).get("num_samples", 10)
+                commands["zeroshot"].append(
+                    f"python -m scripts.zeroshot load_from_model_dir={seed_runs_dir} "
+                    f"task_df_name={task} task_specific_params.num_samples={num_samples}"
+                )
+            for task in emb_tasks:
+                commands["get_embeddings"].append(
+                    f"python -m scripts.get_embeddings load_from_model_dir={seed_runs_dir} "
+                    f"task_df_name={task}"
+                )
+
+    for name, cmds in commands.items():
+        fp = runs_dir / f"{name}_commands.sh"
+        fp.write_text("\n".join(cmds) + "\n")
+        print(f"Wrote {len(cmds)} {name} commands to {fp}")
+
+    (runs_dir / "subset_manifest.json").write_text(
+        json.dumps({"subset_sizes": subset_sizes, "seeds": seeds}, indent=2)
+    )
+    return dict(commands)
+
+
+if __name__ == "__main__":
+    main()

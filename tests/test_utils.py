@@ -107,6 +107,26 @@ def test_load_config_with_yaml_and_overrides(tmp_path: Path):
     assert cfg.nested == {"k": "v"}
 
 
+def test_load_config_declared_defaults_vs_factory_kwargs():
+    """Two regressions around nested-dataclass default seeding:
+
+    1. A plain default factory (OptimizationConfig()) must seed from declared
+       field defaults so __post_init__-derived values (end_lr) don't conflict
+       with overrides of their inputs (init_lr).
+    2. A customizing factory (MetricsConfig(do_skip_all_metrics=True)) must
+       keep its baked-in kwargs.
+    """
+    from eventstreamgpt_tpu.training import PretrainConfig
+
+    cfg = load_config(PretrainConfig, overrides=["optimization_config.init_lr=1e-3"])
+    assert cfg.optimization_config.init_lr == 1e-3
+    # end_lr re-derived from end_lr_frac_of_init_lr, not stale from defaults.
+    assert cfg.optimization_config.end_lr == pytest.approx(1e-6)
+    # The customized metrics factory default survives.
+    assert cfg.pretraining_metrics_config.do_skip_all_metrics is True
+    assert cfg.final_validation_metrics_config.do_skip_all_metrics is False
+
+
 def test_interpolation():
     d = {"base": "/tmp/x", "sub": "${base}/y", "deep": {"z": "${sub}/z"}}
     out = resolve_interpolations(d)

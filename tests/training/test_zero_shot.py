@@ -1,0 +1,219 @@
+"""Zero-shot stack tests: labeler ABC, empirical-probability math, e2e driver.
+
+The toy labeler mimics the reference's in-hospital-mortality example
+(``docs/MIMIC_IV_tutorial/task_labelers/in_hosp_mort_labeler.py``): scan the
+*generated* events for a target vocab index and emit a binary label, marking
+samples with no decisive generated event as unpredictable.
+"""
+
+import json
+import shutil
+from pathlib import Path
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from eventstreamgpt_tpu.data import JaxDataset, PytorchDatasetConfig
+from eventstreamgpt_tpu.models.config import OptimizationConfig, StructuredTransformerConfig
+from eventstreamgpt_tpu.models.zero_shot_labeler import Labeler
+from eventstreamgpt_tpu.training import build_model, save_pretrained
+from eventstreamgpt_tpu.training.fine_tuning import FinetuneConfig
+from eventstreamgpt_tpu.training.zero_shot_evaluator import (
+    get_generative_predictions,
+    import_class_from_file,
+    zero_shot_evaluation,
+)
+
+REF_SAMPLE = Path("/root/reference/sample_data/processed/sample")
+
+MODEL_KWARGS = dict(
+    hidden_size=32,
+    head_dim=8,
+    num_attention_heads=4,
+    num_hidden_layers=2,
+    intermediate_size=32,
+    TTE_generation_layer_type="exponential",
+    max_seq_len=24,  # dataset max_seq_len 16 → 8 generated events
+)
+
+LABELER_SOURCE = '''
+import numpy as np
+from eventstreamgpt_tpu.models.zero_shot_labeler import Labeler
+
+class TaskLabeler(Labeler):
+    """Labels True iff any generated event carries an even dynamic index."""
+
+    def __call__(self, batch, input_seq_len):
+        gen_idx = np.asarray(batch.dynamic_indices)[:, input_seq_len:, :]
+        gen_mask = np.asarray(batch.event_mask)[:, input_seq_len:]
+        has_gen = gen_mask.any(axis=1)
+        hit = ((gen_idx % 2 == 0) & (gen_idx > 0)).any(axis=(1, 2))
+        one_hot = np.zeros((len(has_gen), 2), dtype=np.int64)
+        one_hot[np.arange(len(has_gen)), hit.astype(int)] = 1
+        unpredictable = ~has_gen
+        return one_hot, unpredictable
+'''
+
+
+@pytest.fixture(scope="module")
+def zs_dir(tmp_path_factory):
+    dst = tmp_path_factory.mktemp("zs_sample")
+    for name in ("vocabulary_config.json", "inferred_measurement_configs.json"):
+        shutil.copy(REF_SAMPLE / name, dst / name)
+    shutil.copytree(REF_SAMPLE / "DL_reps", dst / "DL_reps")
+    # Generation's functor updates read the fitted numeric metadata CSVs.
+    shutil.copytree(
+        REF_SAMPLE / "inferred_measurement_metadata", dst / "inferred_measurement_metadata"
+    )
+    shutil.copy(dst / "DL_reps" / "tuning_0.parquet", dst / "DL_reps" / "train_0.parquet")
+
+    # Binary task df + labeler file.
+    frames = [pd.read_parquet(f) for f in (dst / "DL_reps").glob("*.parquet")]
+    raw = pd.concat(frames).drop_duplicates("subject_id")
+    rows = []
+    for _, row in raw.iterrows():
+        t = np.asarray(row["time"], dtype=float)
+        rows.append(
+            {
+                "subject_id": row["subject_id"],
+                "start_time": pd.Timestamp(row["start_time"]),
+                "end_time": pd.Timestamp(row["start_time"]) + pd.Timedelta(minutes=float(t[-1])),
+                "label": bool(int(row["subject_id"]) % 2),
+            }
+        )
+    (dst / "task_dfs").mkdir()
+    pd.DataFrame(rows).to_parquet(dst / "task_dfs" / "mytask.parquet")
+    (dst / "task_dfs" / "mytask_labeler.py").write_text(LABELER_SOURCE)
+
+    # Pretrained generative model dir (left padding: generation needs
+    # right-aligned real events).
+    data_config = PytorchDatasetConfig(
+        save_dir=dst, max_seq_len=16, min_seq_len=2, seq_padding_side="left"
+    )
+    ds = JaxDataset(data_config, "train")
+    config = StructuredTransformerConfig(**MODEL_KWARGS)
+    config.set_to_dataset(ds)
+    config.max_seq_len = 24  # generation budget beyond the dataset window
+    model = build_model(config)
+    batch = next(ds.batches(4, shuffle=False))
+    params = model.init(jax.random.PRNGKey(0), batch)
+    model_dir = dst / "pretrained_model"
+    save_pretrained(model_dir, params, config=config)
+    data_config.to_json_file(model_dir / "data_config.json", do_overwrite=True)
+    return dst, model_dir
+
+
+class TestLabelerImport:
+    def test_import_class_from_file(self, zs_dir):
+        dst, _ = zs_dir
+        cls = import_class_from_file(dst / "task_dfs" / "mytask_labeler.py", "TaskLabeler")
+        assert issubclass(cls, Labeler)
+
+
+class TestEmpiricalPredictions:
+    def test_masked_average_math(self, zs_dir):
+        """The empirical probabilities are the predictability-weighted mean of
+        per-sample one-hot labels (reference ``:243-263``)."""
+        dst, model_dir = zs_dir
+        cfg = FinetuneConfig(
+            load_from_model_dir=model_dir,
+            task_df_name="mytask",
+            data_config_overrides={"seq_padding_side": "left"},
+        )
+        ds = JaxDataset(cfg.data_config, "tuning")
+        config = cfg.config
+        config.set_to_dataset(ds)
+        config.max_seq_len = 24
+        model = build_model(config)
+        batch = next(ds.batches(2, shuffle=False))
+        params = model.init(jax.random.PRNGKey(0), batch)
+
+        calls = {}
+
+        class SpyLabeler(Labeler):
+            def __call__(self, gen_batch, input_seq_len):
+                B = gen_batch.batch_size
+                calls["n"] = B
+                calls["input_seq_len"] = input_seq_len
+                # Sample i gets label i%2; every 3rd sample unpredictable.
+                one_hot = np.zeros((B, 2), dtype=np.int64)
+                one_hot[np.arange(B), np.arange(B) % 2] = 1
+                unpredictable = (np.arange(B) % 3) == 0
+                return one_hot, unpredictable
+
+        out, frac = get_generative_predictions(
+            model,
+            params,
+            config,
+            SpyLabeler(config),
+            batch,
+            jax.random.PRNGKey(1),
+            num_samples=3,
+            max_new_events=4,
+        )
+        assert calls["n"] == 6  # 2 subjects × 3 samples
+        assert calls["input_seq_len"] == batch.sequence_length
+
+        # Subject 0 gets samples 0,1,2 (labels 0,1,0; sample 0 unpredictable)
+        # → prob of class 1 = 1/2. Subject 1 gets samples 3,4,5 (labels
+        # 1,0,1; sample 3 unpredictable) → prob = 1/2.
+        np.testing.assert_allclose(np.asarray(out.preds), [0.5, 0.5])
+        np.testing.assert_allclose(frac, [1 / 3, 1 / 3])
+
+    def test_all_unpredictable_subjects_dropped(self, zs_dir):
+        dst, model_dir = zs_dir
+        cfg = FinetuneConfig(
+            load_from_model_dir=model_dir,
+            task_df_name="mytask",
+            data_config_overrides={"seq_padding_side": "left"},
+        )
+        ds = JaxDataset(cfg.data_config, "tuning")
+        config = cfg.config
+        config.set_to_dataset(ds)
+        config.max_seq_len = 24
+        model = build_model(config)
+        batch = next(ds.batches(2, shuffle=False))
+        params = model.init(jax.random.PRNGKey(0), batch)
+
+        class NoneLabeler(Labeler):
+            def __call__(self, gen_batch, input_seq_len):
+                B = gen_batch.batch_size
+                return np.zeros((B, 2), dtype=np.int64), np.ones(B, dtype=bool)
+
+        out, frac = get_generative_predictions(
+            model, params, config, NoneLabeler(config), batch,
+            jax.random.PRNGKey(1), num_samples=2, max_new_events=4,
+        )
+        assert len(out.preds) == 0
+        np.testing.assert_allclose(frac, [1.0, 1.0])
+
+
+class TestZeroShotDriver:
+    def test_end_to_end(self, zs_dir):
+        dst, model_dir = zs_dir
+        cfg = FinetuneConfig(
+            load_from_model_dir=model_dir,
+            task_df_name="mytask",
+            data_config_overrides={"seq_padding_side": "left"},
+            optimization_config=OptimizationConfig(
+                init_lr=1e-3, batch_size=4, validation_batch_size=4,
+                max_training_steps=1, lr_num_warmup_steps=0, lr_frac_warmup_steps=None,
+            ),
+            task_specific_params={"pooling_method": "last", "num_samples": 2},
+            do_overwrite=True,
+        )
+        tuning_metrics, held_out_metrics = zero_shot_evaluation(cfg)
+
+        assert "tuning_frac_unpredictable" in tuning_metrics
+        assert 0.0 <= tuning_metrics["tuning_frac_unpredictable"] <= 1.0
+        save_dir = Path(cfg.save_dir)
+        assert (save_dir / "zero_shot_tuning_metrics.json").exists()
+        assert (save_dir / "zero_shot_held_out_metrics.json").exists()
+        loaded = json.loads((save_dir / "zero_shot_tuning_metrics.json").read_text())
+        assert loaded == tuning_metrics
+        # Quality metrics exist when at least one subject was predictable.
+        if tuning_metrics["tuning_frac_unpredictable"] < 1.0:
+            assert any("accuracy" in k or "AUROC" in k for k in tuning_metrics)
