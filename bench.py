@@ -24,6 +24,7 @@ import numpy as np
 N_TRAIN, N_TUNING = 512, 64
 N_EVENT_TYPES, N_LABS, N_MEDS = 40, 3500, 500
 BATCH, SEQ_LEN, HIDDEN = 32, 256, 256
+PACKED_BATCH, PACKED_SEQ_LEN = 8, 1024
 MEASURED_EPOCHS = 3
 
 
@@ -122,6 +123,48 @@ def main():
     final_train_loss = float(loss)
     events_per_sec_per_chip = n_events / elapsed / n_devices
 
+    # ---- long-context packed path (BASELINE config 5): seq 1024, packed
+    # variable-length rows with segment-ID attention.
+    packed_config = StructuredTransformerConfig(
+        hidden_size=HIDDEN,
+        head_dim=HIDDEN // 4,
+        num_attention_heads=4,
+        num_hidden_layers=2,
+        seq_attention_types=["local", "global"],
+        seq_window_size=32,
+        intermediate_size=HIDDEN * 4,
+        TTE_generation_layer_type="log_normal_mixture",
+        TTE_lognormal_generation_num_components=3,
+    )
+    packed_config.set_to_dataset(train_ds)
+    packed_config.max_seq_len = PACKED_SEQ_LEN
+    packed_model = build_model(packed_config)
+    packed_tx, _ = build_optimizer(oc)
+    packed_init = next(train_ds.packed_batches(PACKED_BATCH, seq_len=PACKED_SEQ_LEN, seed=0))
+    packed_params = packed_model.init(jax.random.PRNGKey(0), packed_init)
+    packed_state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=packed_params, opt_state=packed_tx.init(packed_params)
+    )
+    packed_state = replicate(packed_state, mesh)
+    packed_step = make_train_step(packed_model, packed_tx)
+
+    packed_state, ploss = packed_step(packed_state, shard_batch(packed_init, mesh), rng)
+    jax.block_until_ready(ploss)
+
+    packed_steps = 0
+    packed_events = 0
+    t0 = time.perf_counter()
+    for epoch in range(MEASURED_EPOCHS):
+        for batch in train_ds.packed_batches(PACKED_BATCH, seq_len=PACKED_SEQ_LEN, seed=1 + epoch):
+            if batch.event_mask.shape[0] != PACKED_BATCH:
+                continue  # short final batch would retrigger compilation
+            packed_events += int(np.asarray(batch.event_mask).sum())
+            packed_state, ploss = packed_step(packed_state, shard_batch(batch, mesh), rng)
+            packed_steps += 1
+    jax.block_until_ready(ploss)
+    packed_elapsed = time.perf_counter() - t0
+    packed_events_per_sec = packed_events / packed_elapsed / n_devices
+
     # Held-out quality signal: tuning NLL via the production eval loop.
     eval_metrics = evaluate(
         make_eval_step(model),
@@ -148,6 +191,8 @@ def main():
                 "n_devices": n_devices,
                 "final_train_loss": round(final_train_loss, 4),
                 "tuning_loss": round(eval_metrics.get("tuning_loss", float("nan")), 4),
+                "packed_seq1024_events_per_sec_per_chip": round(packed_events_per_sec, 1),
+                "packed_seq1024_step_time_ms": round(1000.0 * packed_elapsed / max(packed_steps, 1), 2),
                 "host_input_pipeline": True,
             }
         )

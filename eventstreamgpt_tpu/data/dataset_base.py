@@ -765,6 +765,27 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
             measurements_per_generative_mode=dict(measurements_per_generative_mode),
         )
 
+    # ------------------------------------------------------------- describe
+    def describe(self, do_print_measurement_summaries: bool = True) -> None:
+        """Prints a text summary of the dataset (reference ``dataset_base.py:1196``)."""
+        print(f"Dataset has {len(self.subject_ids)} subjects and {len(self.events_df)} events.")
+        if self.n_events_per_subject:
+            counts = np.asarray(list(self.n_events_per_subject.values()))
+            print(
+                f"Events per subject: min {counts.min()}, median {int(np.median(counts))}, "
+                f"max {counts.max()}"
+            )
+        print(f"Event types ({len(self.event_types)}): {', '.join(self.event_types[:10])}")
+        if do_print_measurement_summaries and self._is_fit:
+            print(f"\nDataset has {len(self.measurement_configs)} measurements:")
+            for _, cfg in self.measurement_configs.items():
+                cfg.describe()
+                print()
+
+    def visualize(self, visualizer, save_dir: Path | str) -> list[Path]:
+        """Plots dataset dashboards via a `Visualizer` (reference ``:1218``)."""
+        return visualizer.plot(self, save_dir)
+
     # --------------------------------------------------------------- DL cache
     @TimeableMixin.TimeAs
     def cache_deep_learning_representation(

@@ -647,6 +647,131 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         return EventStreamBatch(**out)
 
     # -------------------------------------------------------------- batching
+    # ------------------------------------------------------------- packing
+    def packed_batches(
+        self,
+        batch_size: int,
+        seq_len: int | None = None,
+        shuffle: bool = True,
+        seed: int | None = None,
+    ):
+        """Yields packed long-context batches with per-event ``segment_ids``.
+
+        The long-context path (SURVEY §5.7; BASELINE config 5): instead of one
+        right/left-padded subject per row, whole subject sequences are
+        greedily first-fit packed into rows of ``seq_len`` (default
+        ``config.max_seq_len``), with ``segment_ids`` marking subject
+        boundaries. Attention, temporal encoding, and next-event alignment
+        are segment-aware in the CI model, so padding waste drops from
+        ``1 - mean_len/max_len`` to near zero at long sequence lengths.
+
+        Subjects longer than ``seq_len`` are cropped by the configured
+        subsequence-sampling strategy. Static data and stream labels are
+        per-subject, not per-row, and are omitted from packed batches (the
+        packed path targets generative pretraining throughput).
+        """
+        L = seq_len or self.max_seq_len
+        M = self.max_n_dynamic
+        d = self.data
+        n = len(self)
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(n) if shuffle else np.arange(n)
+
+        strategy = self.config.subsequence_sampling_strategy
+
+        # Greedy first-fit packing over a bounded set of open rows: unbounded
+        # first-fit is O(n·rows) in Python — quadratic host time at cohort
+        # scale. A row closes once it cannot fit the smallest subject (or
+        # when the open set exceeds a fixed cap), keeping packing linear with
+        # essentially the same fill quality.
+        min_len = int(
+            min(
+                (min(int(d.subject_event_offsets[s + 1] - d.subject_event_offsets[s]), L) for s in order),
+                default=1,
+            )
+        )
+        MAX_OPEN_ROWS = 64
+        rows: list[list[tuple[int, int, int]]] = []  # [(subject, start, n_events)]
+        row_fill: list[int] = []
+        open_rows: list[int] = []
+        for subj in order:
+            lo, hi = d.subject_event_offsets[subj], d.subject_event_offsets[subj + 1]
+            n_ev = int(hi - lo)
+            start = 0
+            if n_ev > L:
+                if strategy == SubsequenceSamplingStrategy.RANDOM:
+                    start = int(rng.integers(0, n_ev - L + 1))
+                elif strategy == SubsequenceSamplingStrategy.TO_END:
+                    start = n_ev - L
+                n_ev = L
+            placed = False
+            for r in open_rows:
+                if row_fill[r] + n_ev <= L:
+                    rows[r].append((int(subj), start, n_ev))
+                    row_fill[r] += n_ev
+                    placed = True
+                    break
+            if not placed:
+                rows.append([(int(subj), start, n_ev)])
+                row_fill.append(n_ev)
+                open_rows.append(len(rows) - 1)
+            open_rows = [r for r in open_rows if row_fill[r] + min_len <= L]
+            if len(open_rows) > MAX_OPEN_ROWS:
+                open_rows = open_rows[-MAX_OPEN_ROWS:]
+
+        def materialize(row_placements) -> dict:
+            event_ids = np.zeros(L, dtype=np.int64)
+            seg = np.zeros(L, dtype=np.int64)
+            mask = np.zeros(L, dtype=bool)
+            pos = 0
+            for s_idx, (subj, start, n_ev) in enumerate(row_placements):
+                lo = d.subject_event_offsets[subj] + start
+                event_ids[pos : pos + n_ev] = np.arange(lo, lo + n_ev)
+                seg[pos : pos + n_ev] = s_idx
+                mask[pos : pos + n_ev] = True
+                pos += n_ev
+            # Padding shares the last segment id so it never creates a
+            # phantom segment boundary.
+            if row_placements and pos < L:
+                seg[pos:] = seg[pos - 1]
+            return {"event_ids": event_ids, "segment_ids": seg, "event_mask": mask}
+
+        for lo_idx in range(0, len(rows), batch_size):
+            chunk = rows[lo_idx : lo_idx + batch_size]
+            B = len(chunk)
+            parts = [materialize(r) for r in chunk]
+            event_ids = np.stack([p["event_ids"] for p in parts])
+            event_mask = np.stack([p["event_mask"] for p in parts])
+            segment_ids = np.stack([p["segment_ids"] for p in parts])
+
+            time_delta = np.where(event_mask, d.time_delta[event_ids], 0.0).astype(np.float32)
+
+            data_lo = d.event_data_offsets[event_ids]
+            data_n = d.event_data_offsets[event_ids + 1] - data_lo
+            mpos = np.arange(M)[None, None, :]
+            data_ids = data_lo[..., None] + mpos
+            data_valid = (mpos < data_n[..., None]) & event_mask[..., None]
+            data_ids = np.where(data_valid, data_ids, 0)
+
+            dynamic_indices = np.where(data_valid, d.dynamic_indices[data_ids], 0)
+            dynamic_meas = np.where(data_valid, d.dynamic_measurement_indices[data_ids], 0)
+            raw_vals = d.dynamic_values[data_ids]
+            values_mask = data_valid & ~np.isnan(raw_vals)
+            dynamic_values = np.where(
+                values_mask, np.nan_to_num(raw_vals, nan=0.0), 0.0
+            ).astype(np.float32)
+
+            yield EventStreamBatch(
+                event_mask=event_mask,
+                time_delta=time_delta,
+                dynamic_indices=dynamic_indices,
+                dynamic_measurement_indices=dynamic_meas,
+                dynamic_values=dynamic_values,
+                dynamic_values_mask=values_mask,
+                segment_ids=segment_ids,
+                valid_mask=np.ones(B, dtype=bool),
+            )
+
     def _consume_collation_rng(self, subject_indices: np.ndarray, rng: np.random.Generator):
         """Advances ``rng`` exactly as `collate_indices` would, without
         collating — the fast-forward path for mid-epoch resume."""

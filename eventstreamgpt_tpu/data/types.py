@@ -119,6 +119,12 @@ class EventStreamBatch:
     * ``valid_mask``: bool ``(B,)`` — False for wrap-around fill rows in the
       final short eval batch (optional; absent means all rows valid). Eval
       loops must weight per-subject metrics (incl. ``stream_labels``) by it.
+    * ``segment_ids``: int ``(B, L)`` — packed-sequence segment index per
+      event (optional). When present, each row holds several subjects'
+      sequences concatenated; attention, temporal encoding, and next-event
+      alignment all respect segment boundaries (long-context packed path;
+      SURVEY §5.7). Padding positions share the id of the last segment and
+      are excluded by ``event_mask``.
     """
 
     event_mask: Optional[Array] = None
@@ -141,6 +147,8 @@ class EventStreamBatch:
     stream_labels: Optional[dict[str, Array]] = None
 
     valid_mask: Optional[Array] = None
+
+    segment_ids: Optional[Array] = None
 
     # -- dict-like conveniences matching the reference API ------------------
     def keys(self):
@@ -205,6 +213,7 @@ class EventStreamBatch:
                 None if self.stream_labels is None else {k: v[b] for k, v in self.stream_labels.items()}
             ),
             valid_mask=_b(self.valid_mask),
+            segment_ids=None if self.segment_ids is None else self.segment_ids[b, s],
         )
 
     def last_sequence_element_unsqueezed(self) -> "EventStreamBatch":

@@ -1,0 +1,225 @@
+"""Dataset visualization: config + plotting over a built `Dataset`.
+
+Rebuild of ``/root/reference/EventStream/data/visualize.py:14`` on matplotlib
+(the reference uses Plotly, which is not installed in this image; the figures
+are static PNGs instead of interactive HTML, same plot families):
+
+* by-time curves (``plot_by_time``): active subjects, cumulative subjects,
+  cumulative events, events/subject, events/(subject·time), each optionally
+  split by static covariates;
+* by-age curves (``plot_by_age``): cumulative subjects, cumulative events,
+  events/subject over age buckets.
+
+The class is both configuration (JSONable, reference-matching validation) and
+executor: ``plot(dataset, save_dir)`` writes one PNG per plot family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+from ..utils import JSONableMixin, config_dataclass
+
+
+@config_dataclass
+class Visualizer(JSONableMixin):
+    """Visualization config + plotter (reference ``visualize.py:14``).
+
+    Examples:
+        >>> V = Visualizer()
+        >>> V = Visualizer(
+        ...     subset_size=100, subset_random_seed=1,
+        ...     plot_by_age=True, age_col='age', dob_col='dob', n_age_buckets=100,
+        ...     plot_by_time=True, time_unit='1y',
+        ... )
+        >>> Visualizer(subset_size=100)
+        Traceback (most recent call last):
+            ...
+        ValueError: subset_size is specified, but subset_random_seed is not!
+        >>> Visualizer(plot_by_age=True, age_col='age', n_age_buckets=None)
+        Traceback (most recent call last):
+            ...
+        ValueError: plot_by_age is True, but n_age_buckets is unspecified!
+        >>> Visualizer(age_col='age')
+        Traceback (most recent call last):
+            ...
+        ValueError: age_col is specified, but dob_col is not!
+        >>> Visualizer(plot_by_time=True, time_unit=None)
+        Traceback (most recent call last):
+            ...
+        ValueError: plot_by_time is True, but time_unit is unspecified!
+    """
+
+    subset_size: int | None = None
+    subset_random_seed: int | None = None
+
+    static_covariates: list[str] = dataclasses.field(default_factory=list)
+
+    plot_by_time: bool = True
+    time_unit: str | None = "1y"
+
+    plot_by_age: bool = False
+    age_col: str | None = None
+    dob_col: str | None = None
+    n_age_buckets: int | None = 200
+
+    min_sub_to_plot_age_dist: int | None = 50
+
+    def __post_init__(self):
+        if self.subset_size is not None and self.subset_random_seed is None:
+            raise ValueError("subset_size is specified, but subset_random_seed is not!")
+        if self.plot_by_age:
+            if self.age_col is None:
+                raise ValueError("plot_by_age is True, but age_col is unspecified!")
+            if self.n_age_buckets is None:
+                raise ValueError("plot_by_age is True, but n_age_buckets is unspecified!")
+        if self.age_col is not None and self.dob_col is None:
+            raise ValueError("age_col is specified, but dob_col is not!")
+        if self.plot_by_time and self.time_unit is None:
+            raise ValueError("plot_by_time is True, but time_unit is unspecified!")
+
+    # ----------------------------------------------------------------- data
+    def _subject_spans(self, dataset) -> pd.DataFrame:
+        """Per-subject first/last event times + event counts (+ covariates)."""
+        ev = dataset.events_df
+        spans = (
+            ev.groupby("subject_id")["timestamp"]
+            .agg(first="min", last="max", n_events="count")
+            .reset_index()
+        )
+        if self.subset_size is not None and len(spans) > self.subset_size:
+            spans = spans.sample(self.subset_size, random_state=self.subset_random_seed)
+        if self.static_covariates:
+            cov = dataset.subjects_df[["subject_id", *self.static_covariates]]
+            spans = spans.merge(cov, on="subject_id", how="left")
+        return spans
+
+    @staticmethod
+    def _groups(spans: pd.DataFrame, covariates: list[str]):
+        if not covariates:
+            yield "all subjects", spans
+        else:
+            for key, grp in spans.groupby(covariates[0]):
+                yield f"{covariates[0]}={key}", grp
+
+    # ----------------------------------------------------------------- plots
+    def plot(self, dataset, save_dir: Path | str) -> list[Path]:
+        """Writes the configured plot families as PNGs; returns their paths."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        save_dir = Path(save_dir)
+        save_dir.mkdir(parents=True, exist_ok=True)
+        written: list[Path] = []
+
+        spans = self._subject_spans(dataset)
+
+        if self.plot_by_time:
+            fig, axes = plt.subplots(1, 5, figsize=(25, 4))
+            ev = dataset.events_df
+            ts = ev[ev["subject_id"].isin(set(spans["subject_id"]))]["timestamp"]
+            # Grid at time_unit granularity so the rate panel measures events
+            # per (subject · time_unit); very long spans cap at 400 points
+            # (the rate is then per grid interval, noted in the title).
+            if len(ts):
+                grid = pd.date_range(ts.min(), ts.max(), freq=_pd_freq(self.time_unit))
+                rate_unit = self.time_unit
+                if len(grid) < 2 or len(grid) > 400:
+                    grid = pd.date_range(ts.min(), ts.max(), periods=100)
+                    rate_unit = "grid interval"
+            else:
+                grid, rate_unit = [], self.time_unit
+
+            for label, grp in self._groups(spans, self.static_covariates):
+                firsts = grp["first"].to_numpy()
+                lasts = grp["last"].to_numpy()
+                sub_ev = ev[ev["subject_id"].isin(set(grp["subject_id"]))]
+                ev_times = np.sort(sub_ev["timestamp"].to_numpy())
+
+                active = [((firsts <= t.to_datetime64()) & (lasts >= t.to_datetime64())).sum() for t in grid]
+                cum_subj = [(firsts <= t.to_datetime64()).sum() for t in grid]
+                cum_ev = [np.searchsorted(ev_times, t.to_datetime64(), side="right") for t in grid]
+                ev_per_subj = [e / max(s, 1) for e, s in zip(cum_ev, cum_subj)]
+                # events per subject per time_unit, within each grid interval
+                rate = np.diff([0] + cum_ev) / np.maximum(active, 1)
+
+                axes[0].plot(grid, active, label=label)
+                axes[1].plot(grid, cum_subj, label=label)
+                axes[2].plot(grid, cum_ev, label=label)
+                axes[3].plot(grid, ev_per_subj, label=label)
+                axes[4].plot(grid, rate, label=label)
+
+            for ax, title in zip(
+                axes,
+                (
+                    "Active Subjects",
+                    "Cumulative Subjects",
+                    "Cumulative Events",
+                    "Events / Subject",
+                    f"Events / (Subject, {rate_unit})",
+                ),
+            ):
+                ax.set_title(title)
+                ax.set_xlabel("time")
+                ax.tick_params(axis="x", rotation=45)
+                ax.legend(fontsize=6)
+            fig.tight_layout()
+            fp = save_dir / "dataset_by_time.png"
+            fig.savefig(fp, dpi=100)
+            plt.close(fig)
+            written.append(fp)
+
+        if self.plot_by_age:
+            ev = dataset.events_df
+            if self.age_col in ev.columns:
+                ages = ev[["subject_id", self.age_col]].dropna()
+            else:
+                dob = dataset.subjects_df.set_index("subject_id")[self.dob_col]
+                ages = ev[["subject_id", "timestamp"]].copy()
+                dob_per_event = ages["subject_id"].map(dob)
+                ages[self.age_col] = (
+                    (ages["timestamp"] - pd.to_datetime(dob_per_event)).dt.total_seconds()
+                    / (60 * 60 * 24 * 365.25)
+                )
+                ages = ages[["subject_id", self.age_col]].dropna()
+
+            ages = ages[ages["subject_id"].isin(set(spans["subject_id"]))]
+            buckets = np.linspace(
+                ages[self.age_col].min(), ages[self.age_col].max(), self.n_age_buckets
+            )
+            fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+            for label, grp in self._groups(spans, self.static_covariates):
+                sub = ages[ages["subject_id"].isin(set(grp["subject_id"]))]
+                a = np.sort(sub[self.age_col].to_numpy())
+                cum_ev = [np.searchsorted(a, b, side="right") for b in buckets]
+                per_subj_first = sub.groupby("subject_id")[self.age_col].min().to_numpy()
+                cum_subj = [(per_subj_first <= b).sum() for b in buckets]
+                axes[0].plot(buckets, cum_subj, label=label)
+                axes[1].plot(buckets, cum_ev, label=label)
+                axes[2].plot(
+                    buckets, [e / max(s, 1) for e, s in zip(cum_ev, cum_subj)], label=label
+                )
+            for ax, title in zip(
+                axes, ("Cumulative Subjects", "Cumulative Events", "Events / Subject")
+            ):
+                ax.set_title(title)
+                ax.set_xlabel("age")
+                ax.legend(fontsize=6)
+            fig.tight_layout()
+            fp = save_dir / "dataset_by_age.png"
+            fig.savefig(fp, dpi=100)
+            plt.close(fig)
+            written.append(fp)
+
+        return written
+
+
+def _pd_freq(time_unit: str) -> str:
+    """Maps the reference's '1y'-style units to pandas frequency aliases."""
+    return {"1y": "YS", "1mo": "MS", "1w": "W", "1d": "D", "1h": "h"}.get(time_unit, time_unit)

@@ -54,6 +54,17 @@ class ConditionallyIndependentGenerativeOutputLayer(GenerativeOutputLayerBase):
                 (jnp.zeros_like(whole_event_encoded[:, :1, :]), whole_event_encoded[:, :-1, :]),
                 axis=1,
             )
+            if batch.segment_ids is not None:
+                # Packed rows: a segment's first event is predicted from zeros
+                # (like position 0), never from the previous subject's last
+                # event encoding.
+                seg = batch.segment_ids
+                seg_start = jnp.concatenate(
+                    [jnp.ones_like(seg[:, :1], dtype=bool), seg[:, 1:] != seg[:, :-1]], axis=1
+                )
+                for_event_contents_prediction = jnp.where(
+                    seg_start[..., None], 0.0, for_event_contents_prediction
+                )
 
         classification_out = self.get_classification_outputs(
             batch, for_event_contents_prediction, classification_measurements

@@ -285,7 +285,9 @@ class DataEmbeddingLayer(nn.Module):
             mask = mask[..., None]
         embedded = jnp.where(mask, embedded, 0.0)
 
-        if self.static_embedding_mode == StaticEmbeddingMode.DROP:
+        # Batches without static data (e.g. packed long-context batches, where
+        # statics are per-subject and don't pack) degrade to DROP.
+        if self.static_embedding_mode == StaticEmbeddingMode.DROP or batch.static_indices is None:
             return embedded
 
         static_embedded = self._static_embedding(batch)[:, None]  # (B, 1, D)

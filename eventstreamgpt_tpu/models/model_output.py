@@ -187,6 +187,10 @@ class GenerativeOutputLayerBase(nn.Module):
             return None, TTE_dist, None
 
         TTE_obs_mask = batch.event_mask[:, 1:] & batch.event_mask[:, :-1]
+        if batch.segment_ids is not None:
+            # Packed rows: the gap between one subject's last event and the
+            # next subject's first is not a real inter-event time.
+            TTE_obs_mask = TTE_obs_mask & (batch.segment_ids[:, 1:] == batch.segment_ids[:, :-1])
         TTE_delta = batch.time_delta[:, :-1]
         TTE_true = jnp.where(TTE_obs_mask, TTE_delta, 1.0)
 

@@ -113,7 +113,19 @@ def time_from_deltas(batch: EventStreamBatch) -> Array:
     if batch.event_mask is not None:
         t_deltas = jnp.where(batch.event_mask, t_deltas, 0.0)
     csum = jnp.cumsum(t_deltas, axis=-1)
-    return jnp.concatenate([jnp.zeros_like(csum[:, :1]), csum[:, :-1]], axis=1)
+    t = jnp.concatenate([jnp.zeros_like(csum[:, :1]), csum[:, :-1]], axis=1)
+    if batch.segment_ids is not None:
+        # Packed rows: time restarts at each segment. The offset for every
+        # position is t at its segment's first event; t is nondecreasing
+        # (deltas ≥ 0), so a running max over segment-start values forward-
+        # fills the current segment's offset.
+        seg = batch.segment_ids
+        seg_start = jnp.concatenate(
+            [jnp.ones_like(seg[:, :1], dtype=bool), seg[:, 1:] != seg[:, :-1]], axis=1
+        )
+        offsets = jax.lax.cummax(jnp.where(seg_start, t, -jnp.inf), axis=1)
+        t = t - offsets
+    return t
 
 
 class TemporalPositionEncoding(nn.Module):
@@ -183,6 +195,7 @@ class InnerSelfAttention(nn.Module):
         use_cache: bool = False,
         output_attentions: bool = False,
         static_kv_first: bool = False,
+        segment_ids: Array | None = None,  # (B, S): packed-sequence segments
     ):
         cfg = self.config
         embed_dim = cfg.hidden_size
@@ -255,6 +268,14 @@ class InnerSelfAttention(nn.Module):
         mask = causal[None, None]
         if valid_k is not None:
             mask = mask & valid_k[None, None, None, :]
+        if segment_ids is not None:
+            if layer_past is not None or static_kv_first:
+                raise ValueError(
+                    "Packed (segment_ids) batches support neither KV caching nor "
+                    "dep-graph static_kv_first attention."
+                )
+            # Packed rows: queries attend only within their own segment.
+            mask = mask & (segment_ids[:, None, :, None] == segment_ids[:, None, None, :])
         attn_weights = jnp.where(mask, attn_weights, jnp.finfo(jnp.float32).min)
 
         if attention_mask is not None:
@@ -341,6 +362,7 @@ class InnerBlock(nn.Module):
         use_cache=False,
         output_attentions=False,
         static_kv_first: bool = False,
+        segment_ids=None,
     ):
         residual = hidden_states if not static_kv_first else hidden_states[:, 1:, :]
 
@@ -351,6 +373,7 @@ class InnerBlock(nn.Module):
             use_cache=use_cache,
             output_attentions=output_attentions,
             static_kv_first=static_kv_first,
+            segment_ids=segment_ids,
         )
         hidden_states = attn_output + residual
 
@@ -447,6 +470,7 @@ class ConditionallyIndependentPointProcessTransformer(nn.Module):
                 use_cache,
                 output_attentions,
                 False,
+                batch.segment_ids if batch is not None else None,
             )
             # Reference parity: zero masked events' hidden states between
             # layers (``transformer.py:820-825``).
@@ -589,6 +613,11 @@ class NestedAttentionPointProcessTransformer(nn.Module):
         dep_graph_el_generation_target: int | None = None,
     ) -> TransformerOutputWithPast:
         cfg = self.config
+        if batch is not None and batch.segment_ids is not None:
+            raise NotImplementedError(
+                "Packed (segment_ids) batches are only supported by the CI encoder; "
+                "the NA dep-graph attention path requires padded batches."
+            )
         if input_embeds is None:
             input_embeds = NestedAttentionPointProcessInputLayer(cfg, name="input_layer")(
                 batch, dep_graph_el_generation_target=dep_graph_el_generation_target

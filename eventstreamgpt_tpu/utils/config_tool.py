@@ -215,6 +215,27 @@ def parse_override_value(raw: str) -> Any:
         return raw
 
 
+def deep_merge(dst: dict, src: dict) -> dict:
+    """Recursively merges ``src`` into ``dst`` in place (src wins); returns dst."""
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+    return dst
+
+
+def split_config_arg(argv: list[str]) -> tuple[str | None, list[str]]:
+    """Extracts a ``--config <yaml>`` pair from CLI args; returns (path, rest)."""
+    argv = list(argv)
+    yaml_fp = None
+    if "--config" in argv:
+        i = argv.index("--config")
+        yaml_fp = argv[i + 1]
+        del argv[i : i + 2]
+    return yaml_fp, argv
+
+
 def parse_overrides(argv: list[str]) -> dict[str, Any]:
     """Parses ``key=value`` CLI args (Hydra syntax) into a nested dict.
 

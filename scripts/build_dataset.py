@@ -38,7 +38,12 @@ from eventstreamgpt_tpu.data import (
 )
 from eventstreamgpt_tpu.data.dataset_pandas import Query
 from eventstreamgpt_tpu.data.types import DataModality
-from eventstreamgpt_tpu.utils.config_tool import parse_overrides, resolve_interpolations
+from eventstreamgpt_tpu.utils.config_tool import (
+    deep_merge,
+    parse_overrides,
+    resolve_interpolations,
+    split_config_arg,
+)
 
 CONFIGS_DIR = Path(__file__).resolve().parent.parent / "configs"
 
@@ -82,19 +87,12 @@ def load_yaml_with_defaults(yaml_fp: Path | str, configs_dir: Path = CONFIGS_DIR
     raw.pop("hydra", None)
     merged: dict[str, Any] = {}
 
-    def merge(dst: dict, src: dict) -> None:
-        for k, v in src.items():
-            if isinstance(v, dict) and isinstance(dst.get(k), dict):
-                merge(dst[k], v)
-            else:
-                dst[k] = v
-
     for entry in defaults:
         if entry == "_self_":
-            merge(merged, raw)
+            deep_merge(merged, raw)
             raw = {}
         elif isinstance(entry, str):
-            merge(merged, load_yaml_with_defaults(configs_dir / f"{entry}.yaml", configs_dir))
+            deep_merge(merged, load_yaml_with_defaults(configs_dir / f"{entry}.yaml", configs_dir))
         elif isinstance(entry, dict):
             for group, name in entry.items():
                 group_cfg = load_yaml_with_defaults(
@@ -103,7 +101,7 @@ def load_yaml_with_defaults(yaml_fp: Path | str, configs_dir: Path = CONFIGS_DIR
                 merged[group] = group_cfg
         else:
             raise ValueError(f"Can't resolve defaults entry {entry!r}")
-    merge(merged, raw)
+    deep_merge(merged, raw)
     return merged
 
 
@@ -400,24 +398,12 @@ def build_dataset(cfg: dict[str, Any]) -> Dataset:
 
 def main(argv: list[str] | None = None) -> Dataset:
     argv = list(sys.argv[1:] if argv is None else argv)
-    yaml_fp = None
-    if "--config" in argv:
-        i = argv.index("--config")
-        yaml_fp = argv[i + 1]
-        del argv[i : i + 2]
+    yaml_fp, argv = split_config_arg(argv)
     if yaml_fp is None:
         yaml_fp = CONFIGS_DIR / "dataset_base.yaml"
 
     cfg = load_yaml_with_defaults(yaml_fp)
-
-    def merge(dst: dict, src: dict) -> None:
-        for k, v in src.items():
-            if isinstance(v, dict) and isinstance(dst.get(k), dict):
-                merge(dst[k], v)
-            else:
-                dst[k] = v
-
-    merge(cfg, parse_overrides(argv))
+    deep_merge(cfg, parse_overrides(argv))
     cfg = resolve_interpolations(cfg)
     return build_dataset(cfg)
 

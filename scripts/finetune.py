@@ -15,16 +15,12 @@ import sys
 
 from eventstreamgpt_tpu.training.fine_tuning import FinetuneConfig
 from eventstreamgpt_tpu.training.fine_tuning import train as finetune_train
-from eventstreamgpt_tpu.utils.config_tool import load_config
+from eventstreamgpt_tpu.utils.config_tool import load_config, split_config_arg
 
 
 def main(argv: list[str] | None = None):
     argv = list(sys.argv[1:] if argv is None else argv)
-    yaml_fp = None
-    if "--config" in argv:
-        i = argv.index("--config")
-        yaml_fp = argv[i + 1]
-        del argv[i : i + 2]
+    yaml_fp, argv = split_config_arg(argv)
     cfg = load_config(FinetuneConfig, yaml_file=yaml_fp, overrides=argv)
     return finetune_train(cfg)
 

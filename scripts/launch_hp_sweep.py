@@ -26,7 +26,12 @@ from typing import Any
 
 import numpy as np
 
-from eventstreamgpt_tpu.utils.config_tool import parse_overrides, resolve_interpolations
+from eventstreamgpt_tpu.utils.config_tool import (
+    deep_merge,
+    parse_overrides,
+    resolve_interpolations,
+    split_config_arg,
+)
 
 from .build_dataset import CONFIGS_DIR, load_yaml_with_defaults
 
@@ -83,28 +88,15 @@ def sample_trial(parameters: dict[str, dict], rng: np.random.Generator) -> dict[
 
 def main(argv: list[str] | None = None):
     argv = list(sys.argv[1:] if argv is None else argv)
-    yaml_fp = None
-    do_run = False
-    if "--config" in argv:
-        i = argv.index("--config")
-        yaml_fp = argv[i + 1]
-        del argv[i : i + 2]
-    if "--run" in argv:
-        do_run = True
+    do_run = "--run" in argv
+    if do_run:
         argv.remove("--run")
+    yaml_fp, argv = split_config_arg(argv)
     if yaml_fp is None:
         yaml_fp = CONFIGS_DIR / "hyperparameter_sweep_base.yaml"
 
     cfg = load_yaml_with_defaults(yaml_fp)
-
-    def merge(dst: dict, src: dict) -> None:
-        for k, v in src.items():
-            if isinstance(v, dict) and isinstance(dst.get(k), dict):
-                merge(dst[k], v)
-            else:
-                dst[k] = v
-
-    merge(cfg, parse_overrides(argv))
+    deep_merge(cfg, parse_overrides(argv))
     cfg = resolve_interpolations(cfg)
 
     n_trials = int(cfg.get("n_trials", 10))

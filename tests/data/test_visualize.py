@@ -1,0 +1,67 @@
+"""Visualizer + Dataset.describe tests (reference ``data/visualize.py``)."""
+
+from pathlib import Path
+
+import pytest
+
+from eventstreamgpt_tpu.data.visualize import Visualizer
+from tests.data.test_dataset_pandas import build_sample_dataset
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    save_dir = tmp_path_factory.mktemp("viz") / "sample"
+    ESD = build_sample_dataset(save_dir)
+    ESD.split([0.8, 0.1], seed=1)
+    ESD.preprocess()
+    return ESD
+
+
+class TestValidation:
+    def test_reference_validation_errors(self):
+        with pytest.raises(ValueError, match="subset_random_seed"):
+            Visualizer(subset_size=100)
+        with pytest.raises(ValueError, match="n_age_buckets"):
+            Visualizer(plot_by_age=True, age_col="age", dob_col="dob", n_age_buckets=None)
+        with pytest.raises(ValueError, match="dob_col"):
+            Visualizer(age_col="age")
+        with pytest.raises(ValueError, match="time_unit"):
+            Visualizer(plot_by_time=True, time_unit=None)
+
+    def test_json_round_trip(self):
+        v = Visualizer(subset_size=10, subset_random_seed=1, static_covariates=["eye_color"])
+        v2 = Visualizer.from_dict(v.to_dict())
+        assert v2.subset_size == 10 and v2.static_covariates == ["eye_color"]
+
+
+class TestPlots:
+    def test_by_time_plot(self, built, tmp_path):
+        v = Visualizer(plot_by_time=True, time_unit="1y", static_covariates=["eye_color"])
+        written = built.visualize(v, tmp_path)
+        assert (tmp_path / "dataset_by_time.png").exists()
+        assert all(fp.stat().st_size > 1000 for fp in written)
+
+    def test_by_age_plot(self, built, tmp_path):
+        v = Visualizer(
+            plot_by_time=False,
+            plot_by_age=True,
+            age_col="age",
+            dob_col="dob",
+            n_age_buckets=20,
+        )
+        written = built.visualize(v, tmp_path)
+        assert (tmp_path / "dataset_by_age.png").exists()
+        assert len(written) == 1
+
+    def test_subset_sampling(self, built, tmp_path):
+        v = Visualizer(subset_size=10, subset_random_seed=1)
+        spans = v._subject_spans(built)
+        assert len(spans) == 10
+
+
+class TestDescribe:
+    def test_describe_prints(self, built, capsys):
+        built.describe()
+        out = capsys.readouterr().out
+        assert "subjects" in out and "events" in out
+        assert "measurements" in out

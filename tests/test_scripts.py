@@ -218,6 +218,26 @@ class TestSweepLauncher:
         assert len(trials) == 3
         assert (tmp_path / "sweep_commands.sh").exists()
 
+    def test_generated_overrides_load_into_pretrain_config(self, tmp_path):
+        """Every sampled trial's overrides must structure into PretrainConfig —
+        guards against bogus key prefixes from defaults-list resolution."""
+        import json as _json
+
+        from eventstreamgpt_tpu.training import PretrainConfig
+        from eventstreamgpt_tpu.utils.config_tool import load_config
+
+        sweep_main([f"sweep_dir={tmp_path}", "n_trials=2"])
+        trials = _json.loads((tmp_path / "sweep_trials.json").read_text())
+        for trial in trials:
+            overrides = [
+                f"{k}={_json.dumps(v) if not isinstance(v, str) else v}"
+                for k, v in trial.items()
+                if v is not None
+            ]
+            cfg = load_config(PretrainConfig, overrides=overrides)
+            assert "head_dim" in cfg.config
+            assert 8 <= cfg.optimization_config.batch_size <= 128
+
 
 class TestSubsetsPreparer:
     def test_generates_commands(self, tmp_path):
