@@ -103,8 +103,10 @@ class Visualizer(JSONableMixin):
         if not covariates:
             yield "all subjects", spans
         else:
-            for key, grp in spans.groupby(covariates[0]):
-                yield f"{covariates[0]}={key}", grp
+            for key, grp in spans.groupby(covariates):
+                key = key if isinstance(key, tuple) else (key,)
+                label = ", ".join(f"{c}={k}" for c, k in zip(covariates, key))
+                yield label, grp
 
     # ----------------------------------------------------------------- plots
     def plot(self, dataset, save_dir: Path | str) -> list[Path]:
@@ -195,6 +197,11 @@ class Visualizer(JSONableMixin):
             )
             fig, axes = plt.subplots(1, 3, figsize=(15, 4))
             for label, grp in self._groups(spans, self.static_covariates):
+                if (
+                    self.min_sub_to_plot_age_dist is not None
+                    and len(grp) < self.min_sub_to_plot_age_dist
+                ):
+                    continue  # sub-population too small for stable age curves
                 sub = ages[ages["subject_id"].isin(set(grp["subject_id"]))]
                 a = np.sort(sub[self.age_col].to_numpy())
                 cum_ev = [np.searchsorted(a, b, side="right") for b in buckets]

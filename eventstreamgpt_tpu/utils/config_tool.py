@@ -231,6 +231,8 @@ def split_config_arg(argv: list[str]) -> tuple[str | None, list[str]]:
     yaml_fp = None
     if "--config" in argv:
         i = argv.index("--config")
+        if i + 1 >= len(argv):
+            raise ValueError("--config requires a YAML file path argument")
         yaml_fp = argv[i + 1]
         del argv[i : i + 2]
     return yaml_fp, argv

@@ -143,6 +143,21 @@ class TimeableMixin:
         """Returns ``{phase: (total_seconds, n_calls)}`` for all timed phases."""
         return {k: (sum(v), len(v)) for k, v in self._timings.items()}
 
+    def timing_summary(self) -> str:
+        """Formatted per-phase wall-clock table, longest phases first.
+
+        SURVEY §5.1: the reference decorates every ETL phase but never reports
+        the timings; this surfaces them (printed by scripts/build_dataset).
+        """
+        stats = sorted(self._duration_stats().items(), key=lambda kv: -kv[1][0])
+        if not stats:
+            return "(no timed phases)"
+        width = max(len(k) for k, _ in stats)
+        lines = [f"{'phase':<{width}}  total_s  calls"]
+        for k, (total, n) in stats:
+            lines.append(f"{k:<{width}}  {total:7.2f}  {n:5d}")
+        return "\n".join(lines)
+
 
 def to_dict_flat(obj: Any, prefix: str = "") -> dict[str, Any]:
     """Flattens a (possibly nested dataclass/dict) object into dotted keys.

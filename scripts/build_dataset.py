@@ -393,6 +393,8 @@ def build_dataset(cfg: dict[str, Any]) -> Dataset:
     ESD.preprocess()
     ESD.save(do_overwrite=do_overwrite)
     ESD.cache_deep_learning_representation(DL_chunk_size, do_overwrite=do_overwrite)
+    print("\nETL phase timings:")
+    print(ESD.timing_summary())
     return ESD
 
 

@@ -241,3 +241,21 @@ class TestTaskRestriction:
         # Cached task parquet reload path.
         ds2 = JaxDataset(cfg, "tuning")
         assert len(ds2) == len(ds)
+
+    def test_all_empty_windows_keep_column_schema(self, sample_dir):
+        """Task windows that slice no events still yield a correctly-columned
+        (empty) frame, not a 0-column one."""
+        raw = pd.read_parquet(sorted((sample_dir / "DL_reps").glob("tuning*.parquet"))[0])
+        task_rows = [
+            {
+                "subject_id": row["subject_id"],
+                # Window far before the sequence start → empty slice.
+                "start_time": pd.Timestamp(row["start_time"]) - pd.Timedelta(days=400),
+                "end_time": pd.Timestamp(row["start_time"]) - pd.Timedelta(days=399),
+                "label": True,
+            }
+            for _, row in raw.iterrows()
+        ]
+        out = JaxDataset._build_task_cached_df(pd.DataFrame(task_rows), raw)
+        assert len(out) == 0
+        assert "subject_id" in out.columns and "time" in out.columns and "label" in out.columns

@@ -107,6 +107,23 @@ def test_load_config_with_yaml_and_overrides(tmp_path: Path):
     assert cfg.nested == {"k": "v"}
 
 
+def test_timeable_timing_summary():
+    from eventstreamgpt_tpu.utils import TimeableMixin
+
+    class T(TimeableMixin):
+        @TimeableMixin.TimeAs
+        def work(self):
+            return 1
+
+    t = T()
+    assert t.timing_summary() == "(no timed phases)"
+    t.work()
+    t.work()
+    out = t.timing_summary()
+    assert "work" in out and "calls" in out
+    assert t._duration_stats()["work"][1] == 2
+
+
 def test_load_config_declared_defaults_vs_factory_kwargs():
     """Two regressions around nested-dataclass default seeding:
 
