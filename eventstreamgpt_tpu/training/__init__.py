@@ -13,6 +13,7 @@ from .fine_tuning import (
 from .fine_tuning import train as finetune
 from .generative_metrics import GenerativeMetrics
 from .optimizer import build_optimizer, polynomial_decay_with_warmup
+from .sharding import make_mesh, make_param_shardings, shard_params, shard_state
 from .pretrain import (
     PretrainConfig,
     TrainState,
@@ -41,9 +42,13 @@ __all__ = [
     "evaluate",
     "load_pretrained",
     "make_eval_step",
+    "make_mesh",
+    "make_param_shardings",
     "make_train_step",
     "polynomial_decay_with_warmup",
     "replicate",
+    "shard_params",
+    "shard_state",
     "save_pretrained",
     "shard_batch",
     "train",

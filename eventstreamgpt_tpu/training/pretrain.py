@@ -269,7 +269,23 @@ def train(
     tx, lr_schedule = build_optimizer(optimization_config)
 
     oc = optimization_config
-    mesh = data_parallel_mesh(oc.batch_size, oc.validation_batch_size)
+    # Optional tensor parallelism: trainer_config.tensor_parallel_shards > 1
+    # carves a ``model`` axis out of the device set (vocab-sharded embedding
+    # + classification head etc.; see training/sharding.py) with the
+    # remaining devices data-parallel. The data axis shrinks until it divides
+    # both batch sizes, mirroring data_parallel_mesh's fallback.
+    n_tp = int((cfg.trainer_config or {}).get("tensor_parallel_shards") or 1)
+    if n_tp > 1:
+        from .sharding import make_mesh, shard_state
+
+        n_data = max(len(jax.devices()) // n_tp, 1)
+        while n_data > 1 and (oc.batch_size % n_data or oc.validation_batch_size % n_data):
+            n_data -= 1
+        mesh = make_mesh(n_data, n_tp)
+        place_state = lambda s: shard_state(s, mesh)  # noqa: E731
+    else:
+        mesh = data_parallel_mesh(oc.batch_size, oc.validation_batch_size)
+        place_state = lambda s: replicate(s, mesh)  # noqa: E731
 
     # Initialize from the first training batch's shapes.
     if len(train_pyd) < oc.batch_size:
@@ -284,7 +300,7 @@ def train(
     state = TrainState(
         step=jnp.zeros((), dtype=jnp.int32), params=params, opt_state=tx.init(params)
     )
-    state = replicate(state, mesh)
+    state = place_state(state)
 
     tc = dict(cfg.trainer_config)
     log_every = int(tc.get("log_every_n_steps") or 10)
@@ -301,7 +317,7 @@ def train(
         template = serialization.to_state_dict(jax.device_get(state))
         restored_sd, resumed_step = ckpt_mgr.restore(template)
         state = serialization.from_state_dict(jax.device_get(state), restored_sd)
-        state = replicate(state, mesh)
+        state = place_state(state)
         meta = ckpt_mgr.metadata(resumed_step) or {}
         if meta.get("epoch_complete", True):
             start_epoch = int(meta.get("epoch", 0)) + 1

@@ -1,0 +1,117 @@
+"""Parameter sharding rules: data-parallel + tensor-parallel layouts.
+
+The reference's distributed story is data-parallel only (Lightning DDP;
+SURVEY §2.10). TPU-native scaling adds a ``model`` mesh axis with
+Megatron-style tensor parallelism where it pays at event-stream scale:
+
+* the unified vocabulary embedding table and classification head are the
+  widest matrices in the model (vocab can be ~10k+; SURVEY §2.10 names the
+  vocab-sharded ``ClassificationLayer`` as the first TP candidate) — both are
+  sharded over the vocab dimension;
+* MLP blocks split column-then-row (``c_fc`` columns, ``c_proj`` rows) and
+  attention splits by heads (``q/k/v`` columns, ``out_proj`` rows), so each
+  pair needs a single all-reduce inserted by XLA GSPMD.
+
+Everything else stays replicated. Rules are regex → ``PartitionSpec`` over
+flattened parameter paths; unmatched leaves replicate. No explicit
+collectives anywhere — layouts are declared, XLA inserts the psums over
+ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+TP_RULES: list[tuple[str, tuple]] = [
+    (r".*/embed_table$", ("model", None)),
+    (r".*/ClassificationLayer/kernel$", (None, "model")),
+    (r".*/ClassificationLayer/bias$", ("model",)),
+    (r".*/mlp/c_fc/kernel$", (None, "model")),
+    (r".*/mlp/c_fc/bias$", ("model",)),
+    (r".*/mlp/c_proj/kernel$", ("model", None)),
+    (r".*/attention/[qkv]_proj/kernel$", (None, "model")),
+    (r".*/attention/out_proj/kernel$", ("model", None)),
+]
+
+
+def make_mesh(n_data: int, n_model: int = 1, devices=None) -> Mesh:
+    """A 2-D ``(data, model)`` mesh over the first ``n_data·n_model`` devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = n_data * n_model
+    if len(devices) < n:
+        raise ValueError(f"Need {n} devices for a {n_data}x{n_model} mesh; have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]).reshape(n_data, n_model), ("data", "model"))
+
+
+def _leaf_path(path) -> str:
+    return "/".join(str(getattr(p, "key", p)) for p in path)
+
+
+def make_param_shardings(params: Any, mesh: Mesh) -> Any:
+    """NamedSharding tree for ``params``: TP rules + replicated fallback.
+
+    Dimensions that don't divide the ``model`` axis evenly are left
+    unsharded for that rule (GSPMD would handle uneven shards, but even
+    splits keep layouts predictable).
+    """
+    has_model = "model" in mesh.axis_names and mesh.shape.get("model", 1) > 1
+    n_model = mesh.shape.get("model", 1)
+
+    def rule_for(path, leaf):
+        if has_model:
+            p_str = _leaf_path(path)
+            for pattern, spec in TP_RULES:
+                if re.match(pattern, p_str):
+                    # Check divisibility of each sharded dim.
+                    ok = all(
+                        axis is None or leaf.shape[d] % n_model == 0
+                        for d, axis in enumerate(spec)
+                    )
+                    if ok and len(spec) == leaf.ndim:
+                        return NamedSharding(mesh, P(*spec))
+                    break
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(rule_for, params)
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Device-puts parameters per `make_param_shardings`."""
+    return jax.device_put(params, make_param_shardings(params, mesh))
+
+
+def shard_state(state: Any, mesh: Mesh) -> Any:
+    """Shards a `TrainState`: params + optimizer moments follow the same
+    layout, scalars replicate.
+
+    Optimizer moments (adamw ``mu``/``nu``, possibly nested under MultiSteps)
+    are param-structured subtrees; they are detected by tree structure and
+    given the parameter shardings so each moment lives beside its parameter
+    shard.
+    """
+    param_sh = make_param_shardings(state.params, mesh)
+    param_treedef = jax.tree_util.tree_structure(state.params)
+    replicated = NamedSharding(mesh, P())
+
+    def is_param_tree(x) -> bool:
+        try:
+            return jax.tree_util.tree_structure(x) == param_treedef
+        except Exception:
+            return False
+
+    def put(node):
+        if is_param_tree(node):
+            return jax.device_put(node, param_sh)
+        return jax.device_put(node, replicated)
+
+    return type(state)(
+        step=jax.device_put(state.step, replicated),
+        params=jax.device_put(state.params, param_sh),
+        opt_state=jax.tree_util.tree_map(put, state.opt_state, is_leaf=is_param_tree),
+    )

@@ -257,3 +257,16 @@ class TestTrainDriver:
     def test_multi_device_mesh_is_used(self):
         mesh = data_parallel_mesh(4, 4)
         assert mesh.devices.size == min(4, len(jax.devices()))
+
+    def test_tensor_parallel_training(self, sample_dir, tmp_path):
+        """train() with tensor_parallel_shards=2 runs on a dp×tp mesh and
+        produces a finite tuning loss."""
+        cfg = make_pretrain_config(sample_dir, tmp_path, max_epochs=1)
+        cfg.do_final_validation_on_metrics = False
+        cfg.trainer_config = {"log_every_n_steps": 1, "tensor_parallel_shards": 2}
+        train(cfg)
+        records = [
+            json.loads(line) for line in (Path(cfg.save_dir) / "train_log.jsonl").open()
+        ]
+        tuning = [r for r in records if r["split"] == "tuning"]
+        assert tuning and np.isfinite(tuning[-1]["tuning_loss"])
