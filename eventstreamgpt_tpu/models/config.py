@@ -200,6 +200,7 @@ class StructuredTransformerConfig(JSONableMixin):
         num_attention_heads: int = 4,
         seq_attention_types: ATTENTION_TYPES_LIST_T | None = None,
         seq_window_size: int = 32,
+        attention_implementation: str = "einsum",
         dep_graph_attention_types: ATTENTION_TYPES_LIST_T | None = None,
         dep_graph_window_size: int | None = 2,
         intermediate_size: int = 32,
@@ -402,6 +403,12 @@ class StructuredTransformerConfig(JSONableMixin):
         self.dep_graph_attention_layers = dep_graph_attention_layers
 
         self.seq_window_size = seq_window_size
+        if attention_implementation not in ("einsum", "pallas_flash"):
+            raise ValueError(
+                f"attention_implementation must be 'einsum' or 'pallas_flash'; got "
+                f"{attention_implementation}"
+            )
+        self.attention_implementation = attention_implementation
         self.dep_graph_window_size = dep_graph_window_size
 
         missing_param_err_tmpl = f"For a {TTE_generation_layer_type} model, {{}} should not be None"

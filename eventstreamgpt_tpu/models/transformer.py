@@ -258,6 +258,55 @@ class InnerSelfAttention(nn.Module):
                     key=key, value=value, mask=chunk_mask, length=jnp.asarray(S, jnp.int32)
                 )
 
+        # Pallas fused flash-attention fast path (TPU only): full training
+        # forwards/backwards with causal + segment masking fused into a
+        # single kernel, no (L, L) logits materialized in HBM. Falls back to
+        # the einsum path whenever its preconditions don't hold (KV cache,
+        # dep-graph static-kv, local windows, attention dropout, attention-
+        # weight outputs, non-TPU backends).
+        use_pallas = (
+            cfg.attention_implementation == "pallas_flash"
+            and jax.default_backend() == "tpu"
+            and layer_past is None
+            and not static_kv_first
+            and not use_cache
+            and not output_attentions
+            and self.attention_type == "global"
+            and (float(cfg.attention_dropout) == 0.0 or not self.has_rng("dropout"))
+            and S % 128 == 0
+        )
+        if use_pallas:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                SegmentIds,
+                flash_attention,
+            )
+
+            # Padding rides as its own segment id (-1): padded queries attend
+            # only among padded keys (finite outputs, discarded by the
+            # event-mask zeroing between layers).
+            base_seg = (
+                segment_ids
+                if segment_ids is not None
+                else jnp.zeros((B, S), dtype=jnp.int32)
+            )
+            pad_mask = attention_mask if attention_mask is not None else jnp.ones((B, S), bool)
+            seg = jnp.where(pad_mask, base_seg.astype(jnp.int32), -1)
+
+            # GPT-Neo lineage: logits are NOT scaled by 1/sqrt(head_dim).
+            attn_output = flash_attention(
+                query.astype(jnp.float32),
+                key.astype(jnp.float32),
+                value.astype(jnp.float32),
+                segment_ids=SegmentIds(q=seg, kv=seg),
+                causal=True,
+                sm_scale=1.0,
+            ).astype(value.dtype)
+            attn_output = attn_output.swapaxes(-3, -2).reshape(B, q_len, embed_dim)
+            attn_output = out_proj(attn_output)
+            resid_dropout = nn.Dropout(rate=float(cfg.resid_dropout), name="resid_dropout")
+            attn_output = resid_dropout(attn_output, deterministic=not self.has_rng("dropout"))
+            return attn_output, {"present_key_value": None}
+
         window = self.window_size if self.attention_type == "local" else None
         causal = make_causal_mask(q_positions, k_positions, window)  # (Q, K)
 

@@ -278,7 +278,13 @@ def train(
     if n_tp > 1:
         from .sharding import make_mesh, shard_state
 
-        n_data = max(len(jax.devices()) // n_tp, 1)
+        n_devices = len(jax.devices())
+        if n_devices % n_tp != 0:
+            raise ValueError(
+                f"tensor_parallel_shards={n_tp} must divide the device count ({n_devices}); "
+                "a silent partial mesh would waste devices."
+            )
+        n_data = max(n_devices // n_tp, 1)
         while n_data > 1 and (oc.batch_size % n_data or oc.validation_batch_size % n_data):
             n_data -= 1
         mesh = make_mesh(n_data, n_tp)

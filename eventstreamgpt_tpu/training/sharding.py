@@ -63,22 +63,32 @@ def make_param_shardings(params: Any, mesh: Mesh) -> Any:
     has_model = "model" in mesh.axis_names and mesh.shape.get("model", 1) > 1
     n_model = mesh.shape.get("model", 1)
 
+    n_sharded = 0
+
     def rule_for(path, leaf):
+        nonlocal n_sharded
         if has_model:
             p_str = _leaf_path(path)
             for pattern, spec in TP_RULES:
                 if re.match(pattern, p_str):
-                    # Check divisibility of each sharded dim.
-                    ok = all(
+                    # Rank must match before indexing shape for divisibility.
+                    if len(spec) == leaf.ndim and all(
                         axis is None or leaf.shape[d] % n_model == 0
                         for d, axis in enumerate(spec)
-                    )
-                    if ok and len(spec) == leaf.ndim:
+                    ):
+                        n_sharded += 1
                         return NamedSharding(mesh, P(*spec))
                     break
         return NamedSharding(mesh, P())
 
-    return jax.tree_util.tree_map_with_path(rule_for, params)
+    out = jax.tree_util.tree_map_with_path(rule_for, params)
+    if has_model and n_sharded == 0:
+        print(
+            "WARNING: a 'model' mesh axis was requested but no parameter matched a TP rule "
+            f"with dims divisible by {n_model} — all parameters are replicated. Check that "
+            "hidden/vocab dims divide the tensor-parallel shard count."
+        )
+    return out
 
 
 def shard_params(params: Any, mesh: Mesh) -> Any:
