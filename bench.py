@@ -140,8 +140,12 @@ def main():
         head_dim=HIDDEN // 4,
         num_attention_heads=4,
         num_hidden_layers=2,
+        # Global layers ride the fused Pallas flash-attention kernel at long
+        # sequence lengths (attention dropout off — the kernel has none).
         seq_attention_types=["local", "global"],
         seq_window_size=32,
+        attention_implementation="pallas_flash",
+        attention_dropout=0.0,
         intermediate_size=HIDDEN * 4,
         TTE_generation_layer_type="log_normal_mixture",
         TTE_lognormal_generation_num_components=3,

@@ -301,54 +301,53 @@ class InnerSelfAttention(nn.Module):
                 causal=True,
                 sm_scale=1.0,
             ).astype(value.dtype)
-            attn_output = attn_output.swapaxes(-3, -2).reshape(B, q_len, embed_dim)
-            attn_output = out_proj(attn_output)
-            resid_dropout = nn.Dropout(rate=float(cfg.resid_dropout), name="resid_dropout")
-            attn_output = resid_dropout(attn_output, deterministic=not self.has_rng("dropout"))
-            return attn_output, {"present_key_value": None}
+            outputs = {"present_key_value": None}
+        else:
+            window = self.window_size if self.attention_type == "local" else None
+            causal = make_causal_mask(q_positions, k_positions, window)  # (Q, K)
 
-        window = self.window_size if self.attention_type == "local" else None
-        causal = make_causal_mask(q_positions, k_positions, window)  # (Q, K)
+            # fp32 logits for numerical parity with the reference.
+            attn_weights = jnp.einsum(
+                "bhqd,bhkd->bhqk", query.astype(jnp.float32), key.astype(jnp.float32)
+            )
+            mask = causal[None, None]
+            if valid_k is not None:
+                mask = mask & valid_k[None, None, None, :]
+            if segment_ids is not None:
+                if layer_past is not None or static_kv_first:
+                    raise ValueError(
+                        "Packed (segment_ids) batches support neither KV caching nor "
+                        "dep-graph static_kv_first attention."
+                    )
+                # Packed rows: queries attend only within their own segment.
+                mask = mask & (segment_ids[:, None, :, None] == segment_ids[:, None, None, :])
+            attn_weights = jnp.where(mask, attn_weights, jnp.finfo(jnp.float32).min)
 
-        # fp32 logits for numerical parity with the reference.
-        attn_weights = jnp.einsum(
-            "bhqd,bhkd->bhqk", query.astype(jnp.float32), key.astype(jnp.float32)
-        )
-        mask = causal[None, None]
-        if valid_k is not None:
-            mask = mask & valid_k[None, None, None, :]
-        if segment_ids is not None:
-            if layer_past is not None or static_kv_first:
-                raise ValueError(
-                    "Packed (segment_ids) batches support neither KV caching nor "
-                    "dep-graph static_kv_first attention."
+            if attention_mask is not None:
+                # (B, K) boolean padding mask -> additive, matching expand_mask
+                # (transformer.py:28-45).
+                additive = jnp.where(
+                    attention_mask[:, None, None, :], 0.0, jnp.finfo(jnp.float32).min
                 )
-            # Packed rows: queries attend only within their own segment.
-            mask = mask & (segment_ids[:, None, :, None] == segment_ids[:, None, None, :])
-        attn_weights = jnp.where(mask, attn_weights, jnp.finfo(jnp.float32).min)
+                attn_weights = attn_weights + additive
 
-        if attention_mask is not None:
-            # (B, K) boolean padding mask -> additive, matching expand_mask
-            # (transformer.py:28-45).
-            additive = jnp.where(attention_mask[:, None, None, :], 0.0, jnp.finfo(jnp.float32).min)
-            attn_weights = attn_weights + additive
+            # Clamp so stacked masks cannot overflow to -inf: a fully-masked row
+            # then softmaxes to uniform (finite) rather than NaN.
+            attn_weights = jnp.maximum(attn_weights, jnp.finfo(jnp.float32).min)
+            attn_weights = jax.nn.softmax(attn_weights, axis=-1).astype(value.dtype)
+            attn_dropout = nn.Dropout(rate=float(cfg.attention_dropout), name="attn_dropout")
+            attn_weights = attn_dropout(attn_weights, deterministic=not self.has_rng("dropout"))
 
-        # Clamp so stacked masks cannot overflow to -inf: a fully-masked row
-        # then softmaxes to uniform (finite) rather than NaN.
-        attn_weights = jnp.maximum(attn_weights, jnp.finfo(jnp.float32).min)
-        attn_weights = jax.nn.softmax(attn_weights, axis=-1).astype(value.dtype)
-        attn_dropout = nn.Dropout(rate=float(cfg.attention_dropout), name="attn_dropout")
-        attn_weights = attn_dropout(attn_weights, deterministic=not self.has_rng("dropout"))
+            attn_output = jnp.einsum("bhqk,bhkd->bhqd", attn_weights, value)
+            outputs = {"present_key_value": present}
+            if output_attentions:
+                outputs["attn_weights"] = attn_weights
 
-        attn_output = jnp.einsum("bhqk,bhkd->bhqd", attn_weights, value)
+        # Shared tail: merge heads, project, residual dropout.
         attn_output = attn_output.swapaxes(-3, -2).reshape(B, q_len, embed_dim)
         attn_output = out_proj(attn_output)
         resid_dropout = nn.Dropout(rate=float(cfg.resid_dropout), name="resid_dropout")
         attn_output = resid_dropout(attn_output, deterministic=not self.has_rng("dropout"))
-
-        outputs = {"present_key_value": present}
-        if output_attentions:
-            outputs["attn_weights"] = attn_weights
         return attn_output, outputs
 
 

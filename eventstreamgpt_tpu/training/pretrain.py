@@ -287,6 +287,11 @@ def train(
         n_data = max(n_devices // n_tp, 1)
         while n_data > 1 and (oc.batch_size % n_data or oc.validation_batch_size % n_data):
             n_data -= 1
+        if n_data * n_tp < n_devices:
+            print(
+                f"WARNING: batch sizes ({oc.batch_size}/{oc.validation_batch_size}) shrink "
+                f"the data axis to {n_data}; using {n_data * n_tp} of {n_devices} devices."
+            )
         mesh = make_mesh(n_data, n_tp)
         place_state = lambda s: shard_state(s, mesh)  # noqa: E731
     else:

@@ -64,6 +64,7 @@ def make_param_shardings(params: Any, mesh: Mesh) -> Any:
     n_model = mesh.shape.get("model", 1)
 
     n_sharded = 0
+    skipped: list[str] = []
 
     def rule_for(path, leaf):
         nonlocal n_sharded
@@ -78,15 +79,25 @@ def make_param_shardings(params: Any, mesh: Mesh) -> Any:
                     ):
                         n_sharded += 1
                         return NamedSharding(mesh, P(*spec))
+                    skipped.append(f"{p_str} {tuple(leaf.shape)}")
                     break
         return NamedSharding(mesh, P())
 
     out = jax.tree_util.tree_map_with_path(rule_for, params)
+    if has_model and skipped:
+        # Partial failures matter most when the widest matrices (embedding /
+        # classification head — the motivation for TP) are the ones skipped.
+        print(
+            f"WARNING: {len(skipped)} TP-eligible parameter(s) have dims not divisible by "
+            f"the model axis ({n_model}) and stay replicated: "
+            + "; ".join(skipped[:5])
+            + ("; ..." if len(skipped) > 5 else "")
+        )
     if has_model and n_sharded == 0:
         print(
-            "WARNING: a 'model' mesh axis was requested but no parameter matched a TP rule "
-            f"with dims divisible by {n_model} — all parameters are replicated. Check that "
-            "hidden/vocab dims divide the tensor-parallel shard count."
+            "WARNING: a 'model' mesh axis was requested but no parameter is sharded — "
+            "all parameters are replicated. Check that hidden/vocab dims divide the "
+            "tensor-parallel shard count."
         )
     return out
 
