@@ -3,9 +3,22 @@
 Measures the system the north star describes (BASELINE.json config 2 shape,
 MIMIC-IV-tutorial scale), not a resident synthetic batch: a DL-cache parquet
 dataset is written to disk, read back through ``JaxDataset``, host-collated
-inside the timed loop, sharded over the data-parallel mesh, and stepped with
-the production training harness (``eventstreamgpt_tpu.training``). Events are
-counted from the event mask (padding excluded).
+and double-buffered to the device by the asynchronous input pipeline
+(``prefetch_to_device``), and stepped with the production training harness
+(``eventstreamgpt_tpu.training``). Events are counted from the event mask
+(padding excluded). Training runs in bf16 mixed precision (fp32 params,
+fp32 softmax/losses) — the production configuration for TPU.
+
+Sections:
+  * padded seq-256 epochs (the metric of record) + a per-step min-of-N probe
+  * packed seq-1024 long-context epochs (BASELINE config 5) with rows packed
+    **before** the timed window + a per-step probe
+  * tuning-NLL quality signal via the production eval loop
+  * ETL: raw synthetic CSVs → preprocess → DL cache, events/sec
+
+Per-step probes are the kernel-level ground truth (BASELINE.md): the chip is
+reached through a shared tunnel with transient 10-40x contention windows, so
+each wall-clock section also reports its probe for post-hoc explanation.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 vs_baseline = value / 5000 (the driver's north-star events/sec/chip target;
@@ -26,12 +39,110 @@ N_EVENT_TYPES, N_LABS, N_MEDS = 40, 3500, 500
 BATCH, SEQ_LEN, HIDDEN = 32, 256, 256
 PACKED_BATCH, PACKED_SEQ_LEN = 8, 1024
 MEASURED_EPOCHS = 3
+PROBE_STEPS = 10
+
+
+ETL_SUBJECTS = 2000  # ~170k post-agg events: ~10x the training-bench cohort
+
+ETL_YAML = """
+do_overwrite: True
+cohort_name: "etl_bench"
+subject_id_col: "MRN"
+raw_data_dir: "{raw_dir}"
+save_dir: "{save_dir}"
+DL_chunk_size: null
+inputs:
+  subjects:
+    input_df: "${{raw_data_dir}}/subjects.csv"
+  admissions:
+    input_df: "${{raw_data_dir}}/admit_vitals.csv"
+    start_ts_col: "admit_date"
+    end_ts_col: "disch_date"
+    ts_format: "%m/%d/%Y, %H:%M:%S"
+    event_type: ["OUTPATIENT_VISIT", "ADMISSION", "DISCHARGE"]
+  vitals:
+    input_df: "${{raw_data_dir}}/admit_vitals.csv"
+    ts_col: "vitals_date"
+    ts_format: "%m/%d/%Y, %H:%M:%S"
+measurements:
+  static:
+    single_label_classification:
+      subjects: ["eye_color"]
+  functional_time_dependent:
+    age:
+      functor: AgeFunctor
+      necessary_static_measurements: {{ "dob": ["timestamp", "%m/%d/%Y"] }}
+      kwargs: {{ dob_col: "dob" }}
+  dynamic:
+    multi_label_classification:
+      admissions: ["department"]
+    univariate_regression:
+      vitals: ["HR", "temp"]
+outlier_detector_config:
+  cls: stddev_cutoff
+  stddev_cutoff: 4.0
+normalizer_config:
+  cls: standard_scaler
+min_valid_vocab_element_observations: 5
+min_valid_column_observations: 5
+min_true_float_frequency: 0.1
+min_unique_numerical_observations: 20
+min_events_per_subject: 3
+agg_by_time_scale: "1h"
+"""
+
+
+def run_etl_bench() -> dict:
+    """Raw CSVs → build_dataset (ingest, agg, preprocess, DL cache): events/sec.
+
+    The reference's headline claim is preprocessing speed (SURVEY §6, arXiv
+    2306.11547); this times the full ETL script path at ~10x the training
+    bench's cohort. CSV fabrication is not timed.
+    """
+    from eventstreamgpt_tpu.data.synthetic import write_synthetic_raw_csvs
+    from scripts.build_dataset import main as build_dataset_main
+
+    root = Path(tempfile.mkdtemp(prefix="esgpt_etl_bench_"))
+    raw_dir = write_synthetic_raw_csvs(root / "raw", n_subjects=ETL_SUBJECTS, seed=1)
+    save_dir = root / "processed"
+    yaml_fp = root / "dataset.yaml"
+    yaml_fp.write_text(ETL_YAML.format(raw_dir=raw_dir, save_dir=save_dir))
+
+    t0 = time.perf_counter()
+    ESD = build_dataset_main(["--config", str(yaml_fp)])
+    dt = time.perf_counter() - t0
+
+    n_events = len(ESD.events_df)
+    phases = sorted(
+        ((k, round(total, 3)) for k, (total, _) in ESD._duration_stats().items()),
+        key=lambda kv: -kv[1],
+    )
+    return {
+        "etl_events": n_events,
+        "etl_total_s": round(dt, 2),
+        "etl_events_per_sec": round(n_events / dt, 1),
+        "etl_subjects": ETL_SUBJECTS,
+        "etl_phases_s": dict(phases[:6]),
+    }
+
+
+def _probe_step_ms(step_fn, state, batch, rng, n=PROBE_STEPS):
+    """Min-of-n per-step time on a resident batch (tunnel-contention-proof)."""
+    import jax
+
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        state, loss = step_fn(state, batch, rng)
+        jax.block_until_ready(loss)
+        best = min(best, time.perf_counter() - t0)
+    return 1000.0 * best, state
 
 
 def main():
     import jax
 
-    from eventstreamgpt_tpu.data import JaxDataset, PytorchDatasetConfig
+    from eventstreamgpt_tpu.data import JaxDataset, PytorchDatasetConfig, prefetch_to_device
     from eventstreamgpt_tpu.data.synthetic import write_synthetic_dataset
     from eventstreamgpt_tpu.models.config import (
         MetricsConfig,
@@ -78,6 +189,7 @@ def main():
         intermediate_size=HIDDEN * 4,
         TTE_generation_layer_type="log_normal_mixture",
         TTE_lognormal_generation_num_components=3,
+        precision="bf16",
     )
     config.set_to_dataset(train_ds)
 
@@ -104,13 +216,15 @@ def main():
     rng = jax.random.PRNGKey(0)
 
     # Warmup: one step to compile.
-    state, loss = train_step(state, shard_batch(init_batch, mesh), rng)
+    resident = shard_batch(init_batch, mesh)
+    state, loss = train_step(state, resident, rng)
     jax.block_until_ready(loss)
 
-    # ---- measured: full epochs with host IO + collation in the loop. Each
-    # epoch is timed separately and the best epoch is the metric of record:
-    # the TPU is reached through a shared tunnel with transient contention,
-    # and per-epoch timing keeps one slow window from corrupting the run.
+    # ---- measured: full epochs with the async input pipeline (host collation
+    # + device_put in a background thread, depth-2 device buffer). Each epoch
+    # is timed separately and the best epoch is the metric of record: the TPU
+    # is reached through a shared tunnel with transient contention, and
+    # per-epoch timing keeps one slow window from corrupting the run.
     epoch_rates = []
     n_steps = 0
     n_events = 0
@@ -119,9 +233,14 @@ def main():
         ep_events = 0
         ep_steps = 0
         t0 = time.perf_counter()
-        for batch in train_ds.batches(BATCH, shuffle=True, seed=1 + epoch):
-            ep_events += int(np.asarray(batch.event_mask).sum())
-            state, loss = train_step(state, shard_batch(batch, mesh), rng)
+        batch_iter = prefetch_to_device(
+            train_ds.batches(BATCH, shuffle=True, seed=1 + epoch),
+            lambda b: shard_batch(b, mesh),
+            host_stats_fn=lambda b: int(b.event_mask.sum()),
+        )
+        for batch, b_events in batch_iter:
+            ep_events += b_events
+            state, loss = train_step(state, batch, rng)
             ep_steps += 1
         # Donated-state data dependence orders prior steps before this sync.
         jax.block_until_ready(loss)
@@ -132,6 +251,11 @@ def main():
 
     final_train_loss = float(loss)
     events_per_sec_per_chip, best_dt, best_steps = max(epoch_rates)
+
+    # Kernel-level ground truth: min-of-N per-step probe on a resident batch.
+    padded_probe_ms, state = _probe_step_ms(train_step, state, resident, rng)
+    probe_events = int(np.asarray(init_batch.event_mask).sum())
+    padded_probe_rate = probe_events / (padded_probe_ms / 1000.0) / n_devices
 
     # ---- long-context packed path (BASELINE config 5): seq 1024, packed
     # variable-length rows with segment-ID attention.
@@ -149,12 +273,28 @@ def main():
         intermediate_size=HIDDEN * 4,
         TTE_generation_layer_type="log_normal_mixture",
         TTE_lognormal_generation_num_components=3,
+        precision="bf16",
     )
     packed_config.set_to_dataset(train_ds)
     packed_config.max_seq_len = PACKED_SEQ_LEN
     packed_model = build_model(packed_config)
     packed_tx, _ = build_optimizer(oc)
-    packed_init = next(train_ds.packed_batches(PACKED_BATCH, seq_len=PACKED_SEQ_LEN, seed=0))
+
+    # Rows are packed + collated BEFORE the timed window (VERDICT r02 #3): the
+    # timed loop measures device compute + transfer overlap, with the one-off
+    # host packing cost reported separately as packing_time_s.
+    t_pack = time.perf_counter()
+    packed_epochs = []
+    for epoch in range(MEASURED_EPOCHS):
+        eps = [
+            b
+            for b in train_ds.packed_batches(PACKED_BATCH, seq_len=PACKED_SEQ_LEN, seed=1 + epoch)
+            if b.event_mask.shape[0] == PACKED_BATCH  # short tail would retrigger compilation
+        ]
+        packed_epochs.append(eps)
+    packing_time_s = time.perf_counter() - t_pack
+
+    packed_init = packed_epochs[0][0]
     packed_params = packed_model.init(jax.random.PRNGKey(0), packed_init)
     packed_state = TrainState(
         step=jnp.zeros((), jnp.int32), params=packed_params, opt_state=packed_tx.init(packed_params)
@@ -162,24 +302,67 @@ def main():
     packed_state = replicate(packed_state, mesh)
     packed_step = make_train_step(packed_model, packed_tx)
 
-    packed_state, ploss = packed_step(packed_state, shard_batch(packed_init, mesh), rng)
+    packed_resident = shard_batch(packed_init, mesh)
+    packed_state, ploss = packed_step(packed_state, packed_resident, rng)
     jax.block_until_ready(ploss)
 
     packed_rates = []
-    for epoch in range(MEASURED_EPOCHS):
+    for eps in packed_epochs:
+        t0 = time.perf_counter()
         ep_events = 0
         ep_steps = 0
-        t0 = time.perf_counter()
-        for batch in train_ds.packed_batches(PACKED_BATCH, seq_len=PACKED_SEQ_LEN, seed=1 + epoch):
-            if batch.event_mask.shape[0] != PACKED_BATCH:
-                continue  # short final batch would retrigger compilation
-            ep_events += int(np.asarray(batch.event_mask).sum())
-            packed_state, ploss = packed_step(packed_state, shard_batch(batch, mesh), rng)
+        batch_iter = prefetch_to_device(
+            iter(eps),
+            lambda b: shard_batch(b, mesh),
+            host_stats_fn=lambda b: int(b.event_mask.sum()),
+        )
+        for batch, b_events in batch_iter:
+            ep_events += b_events
+            packed_state, ploss = packed_step(packed_state, batch, rng)
             ep_steps += 1
         jax.block_until_ready(ploss)
         dt = time.perf_counter() - t0
         packed_rates.append((ep_events / dt / n_devices, dt, ep_steps))
     packed_events_per_sec, packed_elapsed, packed_steps = max(packed_rates)
+
+    packed_probe_ms, packed_state = _probe_step_ms(packed_step, packed_state, packed_resident, rng)
+    packed_probe_events = int(np.asarray(packed_init.event_mask).sum())
+    packed_probe_rate = packed_probe_events / (packed_probe_ms / 1000.0) / n_devices
+
+    # Generation throughput: cached autoregressive decode over the data mesh
+    # (the zero-shot / trajectory workload; VERDICT r02 next #5). The prompt
+    # is trimmed so the decode fits config.max_seq_len; the first call
+    # compiles, the second is timed.
+    from eventstreamgpt_tpu.generation import generate
+
+    GEN_NEW = 64
+    gen_prompt = next(tuning_ds.batches(BATCH, shuffle=False)).slice(
+        (slice(None), slice(0, SEQ_LEN - GEN_NEW))
+    )
+    gen_key = jax.random.PRNGKey(2)
+
+    def run_generate():
+        out = generate(
+            model,
+            state.params,
+            gen_prompt,
+            config,
+            gen_key,
+            max_new_events=GEN_NEW,
+            use_cache=True,
+            mesh=mesh,
+        )
+        jax.block_until_ready(out.event_mask)
+        return out
+
+    run_generate()  # compile
+    t0 = time.perf_counter()
+    run_generate()
+    gen_dt = time.perf_counter() - t0
+    gen_events_per_sec = BATCH * GEN_NEW / gen_dt / n_devices
+
+    # ETL phase (host-only; independent of the tunnel).
+    etl_metrics = run_etl_bench()
 
     # Held-out quality signal: tuning NLL via the production eval loop.
     eval_metrics = evaluate(
@@ -208,15 +391,31 @@ def main():
                 "n_devices": n_devices,
                 "final_train_loss": round(final_train_loss, 4),
                 "tuning_loss": round(eval_metrics.get("tuning_loss", float("nan")), 4),
+                # Per-step min-of-N probes: kernel-level ground truth that
+                # explains any window-vs-probe gap (tunnel contention).
+                "padded_probe_step_ms": round(padded_probe_ms, 2),
+                "padded_probe_events_per_sec_per_chip": round(padded_probe_rate, 1),
                 "packed_seq1024_events_per_sec_per_chip": round(packed_events_per_sec, 1),
                 "packed_seq1024_step_time_ms": round(1000.0 * packed_elapsed / max(packed_steps, 1), 2),
+                "packed_probe_step_ms": round(packed_probe_ms, 2),
+                "packed_probe_events_per_sec_per_chip": round(packed_probe_rate, 1),
+                "packed_prepacked_before_timing": True,
+                "packing_time_s": round(packing_time_s, 2),
                 "n_params": n_params,
+                "precision": "bf16",
                 # Rough MFU: 6·params FLOPs per event (fwd+bwd dense matmuls,
-                # attention/quadratic terms ignored) vs the v5e bf16 peak.
+                # attention/quadratic terms ignored) vs the v5e bf16 peak —
+                # dtype-matched now that training runs in bf16.
                 "approx_mfu_vs_197tflops": round(
                     events_per_sec_per_chip * 6 * n_params / 197e12, 4
                 ),
+                "probe_mfu_vs_197tflops": round(padded_probe_rate * 6 * n_params / 197e12, 4),
                 "host_input_pipeline": True,
+                "host_overlap": True,
+                "generation_events_per_sec_per_chip": round(gen_events_per_sec, 1),
+                "generation_ms_per_event": round(1000.0 * gen_dt / GEN_NEW, 2),
+                "generation_sharded_over_mesh": True,
+                **etl_metrics,
             }
         )
     )

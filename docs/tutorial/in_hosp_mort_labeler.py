@@ -1,0 +1,68 @@
+"""Worked zero-shot labeler: in-hospital mortality.
+
+The TPU-native counterpart of the reference tutorial's labeler
+(``/root/reference/docs/MIMIC_IV_tutorial/in_hosp_mort_labeler.py``): label a
+subject positive if, among generated future events, a DEATH-typed event
+occurs before any DISCHARGE-typed event. A sample where neither occurs is
+unpredictable (the generation horizon ended while still admitted).
+
+Labelers run on host numpy — copy this file to
+``{dataset_save_dir}/task_dfs/in_hosp_mort_labeler.py`` and run
+``python -m scripts.zeroshot task_df_name=in_hosp_mort ...``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from eventstreamgpt_tpu.data.types import EventStreamBatch
+from eventstreamgpt_tpu.models import get_event_types
+from eventstreamgpt_tpu.models.zero_shot_labeler import Labeler
+
+
+def first_index_of_type(
+    event_types: np.ndarray, wanted: set[int], gen_mask: np.ndarray
+) -> np.ndarray:
+    """Index of the first generated event whose type is in ``wanted``;
+    ``n_generated + 1`` when none is."""
+    n_gen = event_types.shape[1]
+    hit = gen_mask & np.isin(event_types, sorted(wanted))
+    first = np.argmax(hit, axis=1)
+    return np.where(hit.any(axis=1), first, n_gen + 1)
+
+
+class TaskLabeler(Labeler):
+    def __call__(
+        self, batch: EventStreamBatch, input_seq_len: int
+    ) -> tuple[np.ndarray, np.ndarray]:
+        gen_mask = np.asarray(batch.event_mask)[:, input_seq_len:]
+        event_types = np.asarray(
+            get_event_types(
+                np.asarray(batch.dynamic_measurement_indices)[:, input_seq_len:],
+                np.asarray(batch.dynamic_indices)[:, input_seq_len:],
+                self.config.measurements_idxmap["event_type"],
+                self.config.vocab_offsets_by_measurement["event_type"],
+            )
+        )
+
+        # Aggregated event buckets join multiple source types with "&", so an
+        # event "ADMISSION&DEATH" counts as DEATH.
+        death_types = {
+            i for et, i in self.config.event_types_idxmap.items() if "DEATH" in et.split("&")
+        }
+        discharge_types = {
+            i for et, i in self.config.event_types_idxmap.items() if "DISCHARGE" in et.split("&")
+        }
+
+        first_death = first_index_of_type(event_types, death_types, gen_mask)
+        first_discharge = first_index_of_type(event_types, discharge_types, gen_mask)
+
+        n_gen = event_types.shape[1]
+        saw_either = (first_death <= n_gen) | (first_discharge <= n_gen)
+
+        died = first_death < first_discharge
+
+        labels = np.zeros((len(died), 2), dtype=np.float64)
+        labels[np.arange(len(died)), died.astype(int)] = 1.0
+        unpredictable = ~saw_either
+        return labels, unpredictable

@@ -11,6 +11,7 @@ from .config import (
 from .dataset_base import DatasetBase
 from .dataset_pandas import Dataset, Query
 from .jax_dataset import JaxDataset
+from .prefetch import DevicePrefetcher, prefetch_to_device
 from .time_dependent_functor import AgeFunctor, TimeDependentFunctor, TimeOfDayFunctor
 from .types import (
     DataModality,
@@ -30,6 +31,8 @@ __all__ = [
     "DatasetBase",
     "DatasetConfig",
     "DatasetSchema",
+    "DevicePrefetcher",
+    "prefetch_to_device",
     "Query",
     "EventStreamBatch",
     "InputDataType",

@@ -406,15 +406,17 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
             if dynamic_measurements_df is not None:
                 raise ValueError("Can't set dynamic_measurements_df if input_schema is not None!")
 
-            subjects_df, ID_map = self.build_subjects_dfs(input_schema.static)
+            with self._time_as("build_subjects_dfs"):
+                subjects_df, ID_map = self.build_subjects_dfs(input_schema.static)
             subject_id_dtype = subjects_df["subject_id"].dtype
 
-            events_df, dynamic_measurements_df = self.build_event_and_measurement_dfs(
-                ID_map,
-                input_schema.static.subject_id_col,
-                subject_id_dtype,
-                input_schema.dynamic_by_df,
-            )
+            with self._time_as("build_event_and_measurement_dfs"):
+                events_df, dynamic_measurements_df = self.build_event_and_measurement_dfs(
+                    ID_map,
+                    input_schema.static.subject_id_col,
+                    subject_id_dtype,
+                    input_schema.dynamic_by_df,
+                )
 
         self.config = config
         self._is_fit = False
@@ -430,16 +432,20 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         self.event_types = []
         self.n_events_per_subject = {}
 
-        (
-            self.subjects_df,
-            self.events_df,
-            self.dynamic_measurements_df,
-        ) = self._validate_initial_dfs(subjects_df, events_df, dynamic_measurements_df)
+        with self._time_as("_validate_initial_dfs"):
+            (
+                self.subjects_df,
+                self.events_df,
+                self.dynamic_measurements_df,
+            ) = self._validate_initial_dfs(subjects_df, events_df, dynamic_measurements_df)
 
         if self.events_df is not None:
-            self._agg_by_time()
-            self._sort_events()
-        self._update_subject_event_properties()
+            with self._time_as("_agg_by_time"):
+                self._agg_by_time()
+            with self._time_as("_sort_events"):
+                self._sort_events()
+        with self._time_as("_update_subject_event_properties"):
+            self._update_subject_event_properties()
 
     # ------------------------------------------------------------- filtering
     @TimeableMixin.TimeAs

@@ -410,10 +410,33 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
         # final (subject, timestamp)-sorted event ids.
         new_ids = gb.ngroup()
 
-        grouped = (
-            gb.agg(event_type=("event_type", lambda s: "&".join(sorted(set(s)))))
-            .reset_index()
-            .rename(columns={"_bucket": "timestamp"})
+        # ETL hot loop #1 (SURVEY §3.1): the reference's polars groupby_dynamic
+        # is Rust; a pandas groupby with a Python "&".join lambda per bucket
+        # costs ~40µs/event. Vectorized instead: group ids are nondecreasing
+        # over the sorted rows, so per-group metadata is a take at group
+        # starts, and the sorted-unique event-type union only needs Python
+        # for the rare multi-type buckets.
+        gid = new_ids.to_numpy()
+        g_starts = np.unique(gid, return_index=True)[1]
+        pairs = (
+            pd.DataFrame({"gid": gid, "et": ev["event_type"].to_numpy()})
+            .drop_duplicates()
+            .sort_values(["gid", "et"], kind="stable")
+        )
+        p_gid = pairs["gid"].to_numpy()
+        p_et = pairs["et"].to_numpy()
+        p_starts = np.unique(p_gid, return_index=True)[1]
+        p_counts = np.diff(np.append(p_starts, len(p_gid)))
+        event_type = p_et[p_starts].astype(object)
+        for i in np.flatnonzero(p_counts > 1):
+            event_type[i] = "&".join(p_et[p_starts[i] : p_starts[i] + p_counts[i]])
+
+        grouped = pd.DataFrame(
+            {
+                "subject_id": ev["subject_id"].to_numpy()[g_starts],
+                "timestamp": ev["_bucket"].to_numpy()[g_starts],
+                "event_type": event_type,
+            }
         )
         max_id = len(grouped)
         id_dt = (
@@ -1020,46 +1043,58 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
 
         long = pd.concat([event_long, dynamic_long], ignore_index=True, sort=False)
 
-        # Group measurements per event, keeping the event's timestamp/subject.
-        per_event = (
-            long.groupby("event_id")
-            .agg(
-                timestamp=("timestamp", lambda s: s.dropna().iloc[0] if s.notna().any() else pd.NaT),
-                subject_id=("subject_id", lambda s: s.dropna().iloc[0] if s.notna().any() else None),
-                dynamic_measurement_indices=("measurement_index", list),
-                dynamic_indices=("index", list),
-                dynamic_values=("value", list),
-            )
-            .reset_index()
+        # Group measurements per event. This is ETL hot loop #3 (SURVEY §3.1);
+        # a groupby with Python-lambda aggregators costs ~300µs/event, so the
+        # ragged grouping is done with a stable sort + np.unique/np.split
+        # instead — identical output (same group order, same within-group
+        # order), linear numpy cost. Timestamps/subjects come straight from
+        # events_df (every event_id in `long` originates there).
+        long = long.sort_values("event_id", kind="stable")
+        ev_ids = long["event_id"].to_numpy()
+        uniq_ev, ev_starts = np.unique(ev_ids, return_index=True)
+        split_at = ev_starts[1:]
+        per_event = pd.DataFrame(
+            {
+                "event_id": uniq_ev,
+                "dynamic_measurement_indices": np.split(
+                    long["measurement_index"].to_numpy(), split_at
+                ),
+                "dynamic_indices": np.split(long["index"].to_numpy(), split_at),
+                "dynamic_values": np.split(long["value"].to_numpy(), split_at),
+            }
         )
-        # Events whose measurements all came from the dynamic df need their
-        # timestamp/subject from events_df.
+        for c in ("dynamic_measurement_indices", "dynamic_indices", "dynamic_values"):
+            per_event[c] = per_event[c].map(np.ndarray.tolist)
         ev_meta = events_df.set_index("event_id")[["timestamp", "subject_id"]]
-        missing_ts = per_event["timestamp"].isna()
-        if missing_ts.any():
-            fill = per_event.loc[missing_ts, "event_id"].map(ev_meta["timestamp"])
-            per_event.loc[missing_ts, "timestamp"] = fill
-        missing_subj = per_event["subject_id"].isna()
-        if missing_subj.any():
-            fill = per_event.loc[missing_subj, "event_id"].map(ev_meta["subject_id"])
-            per_event.loc[missing_subj, "subject_id"] = fill
+        per_event["timestamp"] = per_event["event_id"].map(ev_meta["timestamp"])
+        per_event["subject_id"] = per_event["event_id"].map(ev_meta["subject_id"])
 
         per_event = per_event.sort_values(["subject_id", "timestamp"]).reset_index(drop=True)
 
-        event_data = (
-            per_event.groupby("subject_id", sort=True)
-            .agg(
-                start_time=("timestamp", "first"),
-                time=(
-                    "timestamp",
-                    lambda s: ((s - s.min()).dt.total_seconds() / 60.0).tolist(),
+        # Same vectorized grouping per subject: rows are sorted by
+        # (subject_id, timestamp), so each subject's first timestamp is its
+        # min and slices preserve time order.
+        sub_ids = per_event["subject_id"].to_numpy()
+        uniq_sub, sub_starts = np.unique(sub_ids, return_index=True)
+        counts = np.diff(np.append(sub_starts, len(sub_ids)))
+        ts = per_event["timestamp"].to_numpy(dtype="datetime64[ns]")
+        start_ts = ts[sub_starts]
+        rel_min = (ts - np.repeat(start_ts, counts)) / np.timedelta64(1, "m")
+        sub_split = sub_starts[1:]
+        event_data = pd.DataFrame(
+            {
+                "subject_id": uniq_sub,
+                "start_time": start_ts,
+                "time": [a.tolist() for a in np.split(rel_min, sub_split)],
+                "dynamic_measurement_indices": np.split(
+                    per_event["dynamic_measurement_indices"].to_numpy(), sub_split
                 ),
-                dynamic_measurement_indices=("dynamic_measurement_indices", list),
-                dynamic_indices=("dynamic_indices", list),
-                dynamic_values=("dynamic_values", list),
-            )
-            .reset_index()
+                "dynamic_indices": np.split(per_event["dynamic_indices"].to_numpy(), sub_split),
+                "dynamic_values": np.split(per_event["dynamic_values"].to_numpy(), sub_split),
+            }
         )
+        for c in ("dynamic_measurement_indices", "dynamic_indices", "dynamic_values"):
+            event_data[c] = event_data[c].map(np.ndarray.tolist)
 
         out = static_data.merge(event_data, on="subject_id", how="outer")
         if do_sort_outputs:

@@ -21,3 +21,4 @@ from .embedding import (  # noqa: F401
     StaticEmbeddingMode,
 )
 from .fine_tuning_model import ESTForStreamClassification  # noqa: F401
+from .model_output import get_event_types  # noqa: F401

@@ -83,6 +83,9 @@ class DataEmbeddingLayer(nn.Module):
     categorical_weight: float = 0.5
     numerical_weight: float = 0.5
     embed_dtype: jnp.dtype = jnp.float32
+    # Activation/matmul dtype (mixed precision); params stay in embed_dtype.
+    # None means "same as embed_dtype" (the fp32 default).
+    compute_dtype: jnp.dtype | None = None
 
     def __post_init__(self):
         super().__post_init__()
@@ -139,6 +142,10 @@ class DataEmbeddingLayer(nn.Module):
                             )
 
     @property
+    def _compute(self) -> jnp.dtype:
+        return self.compute_dtype if self.compute_dtype is not None else self.embed_dtype
+
+    @property
     def embedding_mode(self) -> EmbeddingMode:
         if self.categorical_embedding_dim is None and self.numerical_embedding_dim is None:
             return EmbeddingMode.JOINT
@@ -173,33 +180,35 @@ class DataEmbeddingLayer(nn.Module):
                 (self.n_total_embeddings, self.categorical_embedding_dim),
                 self.embed_dtype,
             )
-            self.cat_proj = nn.Dense(self.out_dim, dtype=self.embed_dtype, name="cat_proj")
+            self.cat_proj = nn.Dense(self.out_dim, dtype=self._compute, name="cat_proj")
             self.numerical_embed_table = self.param(
                 "numerical_embed_table",
                 init,
                 (self.n_total_embeddings, self.numerical_embedding_dim),
                 self.embed_dtype,
             )
-            self.num_proj = nn.Dense(self.out_dim, dtype=self.embed_dtype, name="num_proj")
+            self.num_proj = nn.Dense(self.out_dim, dtype=self._compute, name="num_proj")
 
     def _joint_embed(self, indices, measurement_indices, values=None, values_mask=None):
         if values is None:
-            values = jnp.ones(indices.shape, dtype=self.embed_dtype)
+            values = jnp.ones(indices.shape, dtype=self._compute)
         else:
             values = jnp.where(values_mask, values, 1.0)
         if self.do_normalize_by_measurement_index:
             values = values * measurement_index_normalization(measurement_indices)
-        return embedding_bag(self.embed_table, indices, values)
+        return embedding_bag(self.embed_table.astype(self._compute), indices, values)
 
     def _split_embed(self, indices, measurement_indices, values=None, values_mask=None, cat_mask=None):
-        cat_values = jnp.ones(indices.shape, dtype=self.embed_dtype)
+        cat_values = jnp.ones(indices.shape, dtype=self._compute)
         if cat_mask is not None:
             cat_values = jnp.where(cat_mask, cat_values, 0.0)
         if self.do_normalize_by_measurement_index:
             meas_norm = measurement_index_normalization(measurement_indices)
             cat_values = cat_values * meas_norm
 
-        cat_embeds = self.cat_proj(embedding_bag(self.categorical_embed_table, indices, cat_values))
+        cat_embeds = self.cat_proj(
+            embedding_bag(self.categorical_embed_table.astype(self._compute), indices, cat_values)
+        )
 
         if values is None:
             return cat_embeds
@@ -207,7 +216,9 @@ class DataEmbeddingLayer(nn.Module):
         num_values = jnp.where(values_mask, values, 0.0)
         if self.do_normalize_by_measurement_index:
             num_values = num_values * meas_norm
-        num_embeds = self.num_proj(embedding_bag(self.numerical_embed_table, indices, num_values))
+        num_embeds = self.num_proj(
+            embedding_bag(self.numerical_embed_table.astype(self._compute), indices, num_values)
+        )
 
         return self._categorical_frac * cat_embeds + self._numerical_frac * num_embeds
 

@@ -57,12 +57,13 @@ class ESTForStreamClassification(nn.Module):
 
         self.pooling_method = (config.task_specific_params or {}).get("pooling_method", "last")
 
+        dt = config.compute_dtype
         if self.is_binary:
             if config.num_labels != 2:
                 raise ValueError(f"Binary task must have num_labels == 2; got {config.num_labels}")
-            self.logit_layer = nn.Dense(1)
+            self.logit_layer = nn.Dense(1, dtype=dt)
         else:
-            self.logit_layer = nn.Dense(config.num_labels)
+            self.logit_layer = nn.Dense(config.num_labels, dtype=dt)
 
     def __call__(self, batch: EventStreamBatch, **kwargs) -> StreamClassificationModelOutput:
         encoded = self.encoder(batch, **kwargs).last_hidden_state
@@ -91,7 +92,7 @@ class ESTForStreamClassification(nn.Module):
         else:
             raise ValueError(f"{self.pooling_method} is not a supported pooling method.")
 
-        logits = self.logit_layer(stream_encoded)
+        logits = self.logit_layer(stream_encoded).astype(jnp.float32)
         task = self.config.finetuning_task
         labels = batch.stream_labels[task]
 

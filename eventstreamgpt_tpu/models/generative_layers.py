@@ -28,10 +28,14 @@ class LogNormalMixtureTTELayer(nn.Module):
     num_components: int
     mean_log_inter_time: float = 0.0
     std_log_inter_time: float = 1.0
+    # Projection matmul dtype (mixed precision); distribution params are
+    # always upcast to fp32 so log-prob math stays fp32.
+    dtype: jnp.dtype | None = None
 
     @nn.compact
     def __call__(self, T: jnp.ndarray) -> LogNormalMixture:
-        params = nn.Dense(3 * self.num_components, name="proj")(T)
+        params = nn.Dense(3 * self.num_components, dtype=self.dtype, name="proj")(T)
+        params = params.astype(jnp.float32)
         return LogNormalMixture(
             locs=params[..., 0::3],
             log_scales=params[..., 1::3],
@@ -44,9 +48,12 @@ class LogNormalMixtureTTELayer(nn.Module):
 class ExponentialTTELayer(nn.Module):
     """Exponential time-to-event head (``generative_layers.py:62``)."""
 
+    dtype: jnp.dtype | None = None
+
     @nn.compact
     def __call__(self, T: jnp.ndarray) -> Exponential:
-        rate = _elu_plus_one(nn.Dense(1, name="proj")(T))
+        z = nn.Dense(1, dtype=self.dtype, name="proj")(T).astype(jnp.float32)
+        rate = _elu_plus_one(z)
         return Exponential(rate=rate[..., 0])
 
 
@@ -59,10 +66,12 @@ class GaussianIndexedRegressionLayer(nn.Module):
     """
 
     n_regression_targets: int
+    dtype: jnp.dtype | None = None
 
     @nn.compact
     def __call__(self, X: jnp.ndarray, idx: jnp.ndarray | None = None) -> Normal:
-        Z = nn.Dense(self.n_regression_targets * 2, name="proj")(X)
+        Z = nn.Dense(self.n_regression_targets * 2, dtype=self.dtype, name="proj")(X)
+        Z = Z.astype(jnp.float32)
         Z_mean = Z[..., 0::2]
         Z_std = _elu_plus_one(Z[..., 1::2])
         if idx is None:
@@ -75,7 +84,9 @@ class GaussianIndexedRegressionLayer(nn.Module):
 class GaussianRegressionLayer(nn.Module):
     """Univariate probabilistic regression head (``generative_layers.py:149``)."""
 
+    dtype: jnp.dtype | None = None
+
     @nn.compact
     def __call__(self, X: jnp.ndarray) -> Normal:
-        Z = nn.Dense(2, name="proj")(X)
+        Z = nn.Dense(2, dtype=self.dtype, name="proj")(X).astype(jnp.float32)
         return Normal(loc=Z[..., 0::2], scale=_elu_plus_one(Z[..., 1::2]))

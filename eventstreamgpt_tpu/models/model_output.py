@@ -105,6 +105,32 @@ class StreamClassificationModelOutput:
     labels: Optional[Array] = None
 
 
+def get_event_types(
+    dynamic_measurement_indices,
+    dynamic_indices,
+    event_type_measurement_idx: int,
+    event_type_vocab_offset: int,
+):
+    """Per-event event-type vocabulary indices (local to the event-type vocab).
+
+    Reference: ``model_output.py:41-105``. Every event carries exactly one
+    ``event_type`` data element; this extracts its index and rebases it by the
+    measurement's vocab offset. Works on numpy or jnp arrays (the zero-shot
+    labeler surface is host numpy).
+
+    Examples:
+        >>> import numpy as np
+        >>> meas = np.asarray([[[1, 2, 0], [1, 2, 2]]])
+        >>> idx = np.asarray([[[3, 7, 0], [4, 8, 9]]])
+        >>> get_event_types(meas, idx, event_type_measurement_idx=1,
+        ...                 event_type_vocab_offset=1)
+        array([[2, 3]])
+    """
+    is_event_type = dynamic_measurement_indices == event_type_measurement_idx
+    event_type_indices = (dynamic_indices * is_event_type).sum(-1)
+    return event_type_indices - event_type_vocab_offset
+
+
 def get_measurement_vocab_slice(config: StructuredTransformerConfig, measurement: str) -> tuple[int, int]:
     """[vocab_start, vocab_end) of a measurement in the unified vocabulary.
 
@@ -144,20 +170,25 @@ class GenerativeOutputLayerBase(nn.Module):
                 f"({TimeToEventGenerationHeadType.values()}). got {cfg.TTE_generation_layer_type}."
             )
 
-        self.IsObservedLayer = nn.Dense(len(cfg.measurements_idxmap), name="IsObservedLayer")
-        self.ClassificationLayer = nn.Dense(cfg.vocab_size, name="ClassificationLayer")
+        # Head matmuls run in the compute dtype (the vocab-size classification
+        # projection is the largest matmul in the model); logits are upcast to
+        # fp32 before any log-prob/loss math below.
+        dt = cfg.compute_dtype
+        self.IsObservedLayer = nn.Dense(len(cfg.measurements_idxmap), dtype=dt, name="IsObservedLayer")
+        self.ClassificationLayer = nn.Dense(cfg.vocab_size, dtype=dt, name="ClassificationLayer")
 
         regression_layers = {}
         for measurement in cfg.measurements_for(DataModality.MULTIVARIATE_REGRESSION):
             regression_layers[measurement] = GaussianIndexedRegressionLayer(
                 n_regression_targets=cfg.vocab_sizes_by_measurement[measurement],
+                dtype=dt,
                 name=f"regression_layer_{measurement}",
             )
         for measurement in cfg.measurements_for(DataModality.UNIVARIATE_REGRESSION):
             if measurement in regression_layers:
                 raise ValueError(f"{measurement} duplicated!")
             regression_layers[measurement] = GaussianRegressionLayer(
-                name=f"regression_layer_{measurement}"
+                dtype=dt, name=f"regression_layer_{measurement}"
             )
         self.regression_layers = regression_layers
 
@@ -224,8 +255,8 @@ class GenerativeOutputLayerBase(nn.Module):
         if not valid_measurements:
             return {}, {}, {}
 
-        is_observed_score = self.IsObservedLayer(encoded)
-        classification_scores = self.ClassificationLayer(encoded)
+        is_observed_score = self.IsObservedLayer(encoded).astype(jnp.float32)
+        classification_scores = self.ClassificationLayer(encoded).astype(jnp.float32)
 
         losses, dists, labels_out = {}, {}, {}
 
@@ -308,7 +339,7 @@ class GenerativeOutputLayerBase(nn.Module):
         if not valid_measurements:
             return {}, {}, {}, {}
 
-        is_observed_score = self.IsObservedLayer(encoded)
+        is_observed_score = self.IsObservedLayer(encoded).astype(jnp.float32)
 
         loss_values, dists, labels_out, indices_out = {}, {}, {}, {}
 

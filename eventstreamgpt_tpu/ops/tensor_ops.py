@@ -20,7 +20,7 @@ def str_summary(T) -> str:
         >>> import jax.numpy as jnp
         >>> T = jnp.asarray([[[1., 2., 3., 4., 5.], [6., 7., 8., 9., 10.]]])
         >>> str_summary(T)
-        'shape: (1, 2, 5), type: float32, range: 1.0-10.0'
+        'shape: (1, 2, 5), type: float32, range: 1-10'
     """
     return f"shape: {tuple(T.shape)}, type: {T.dtype}, range: {T.min():n}-{T.max():n}"
 
@@ -165,9 +165,10 @@ def measurement_index_normalization(measurement_indices: jnp.ndarray) -> jnp.nda
 
     Examples:
         >>> import jax.numpy as jnp
+        >>> import numpy as np
         >>> mi = jnp.asarray([[1, 2, 5, 2, 2], [1, 3, 5, 3, 0]])
-        >>> measurement_index_normalization(mi).round(4)
-        Array([[0.3333, 0.1111, 0.3333, 0.1111, 0.1111],
+        >>> np.asarray(measurement_index_normalization(mi)).round(4)
+        array([[0.3333, 0.1111, 0.3333, 0.1111, 0.1111],
                [0.3333, 0.1667, 0.3333, 0.1667, 0.    ]], dtype=float32)
     """
     # Pairwise-equality formulation needs no static vocab bound:

@@ -33,6 +33,7 @@ from flax import serialization
 
 from ..data.config import PytorchDatasetConfig
 from ..data.jax_dataset import JaxDataset
+from ..data.prefetch import prefetch_to_device
 from ..models.config import OptimizationConfig, Split, StructuredTransformerConfig
 from ..models.fine_tuning_model import ESTForStreamClassification
 from ..utils import config_dataclass
@@ -140,6 +141,8 @@ class FinetuneConfig:
     save_dir: str | Path | None = None
 
     do_overwrite: bool = False
+    # Debug mode: NaN provenance via ``jax_debug_nans`` (see PretrainConfig).
+    do_detect_anomaly: bool = False
 
     optimization_config: OptimizationConfig = dataclasses.field(default_factory=OptimizationConfig)
 
@@ -272,6 +275,9 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     np.random.seed(cfg.seed)
     rng = jax.random.PRNGKey(cfg.seed)
 
+    if getattr(cfg, "do_detect_anomaly", False):
+        jax.config.update("jax_debug_nans", True)
+
     train_pyd = JaxDataset(cfg.data_config, split="train")
     tuning_pyd = JaxDataset(cfg.data_config, split="tuning")
 
@@ -333,14 +339,19 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     def evaluate(params, dataset, split) -> dict[str, float]:
         metrics = StreamClassificationMetrics(config, split)
         # seed=0 pins random subsequence crops: eval passes must be comparable.
-        for batch in dataset.batches(
-            oc.validation_batch_size, shuffle=False, drop_last=False, seed=0
-        ):
-            n_valid = (
-                int(np.asarray(batch.valid_mask).sum()) if batch.valid_mask is not None else None
-            )
-            out = eval_step(params, shard_batch(batch, mesh))
-            metrics.update(out, n_valid=n_valid)
+        batch_iter = prefetch_to_device(
+            dataset.batches(oc.validation_batch_size, shuffle=False, drop_last=False, seed=0),
+            lambda b: shard_batch(b, mesh),
+            host_stats_fn=lambda b: (
+                int(np.asarray(b.valid_mask).sum()) if b.valid_mask is not None else None
+            ),
+        )
+        try:
+            for batch, n_valid in batch_iter:
+                out = eval_step(params, batch)
+                metrics.update(out, n_valid=n_valid)
+        finally:
+            batch_iter.close()
         return metrics.compute()
 
     tc = dict(cfg.trainer_config or {})
@@ -366,30 +377,37 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     for epoch in range(oc.max_epochs):
         epoch_t0 = time.perf_counter()
         window_losses = []
-        for batch in train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed + epoch):
-            state, loss = train_step(state, shard_batch(batch, mesh), rng)
-            global_step += 1
-            window_losses.append(loss)
-            if global_step % log_every == 0:
-                log_record(
-                    {
-                        "split": str(Split.TRAIN),
-                        "epoch": epoch,
-                        "step": global_step,
-                        "train_loss": float(jnp.mean(jnp.stack(window_losses))),
-                        "lr": float(lr_schedule(global_step // accum)),
-                    }
-                )
-                window_losses = []
-            if global_step % ckpt_every == 0:
-                ckpt_mgr.save(
-                    global_step,
-                    serialization.to_state_dict(jax.device_get(state)),
-                    metadata={"epoch": epoch, "epoch_complete": False},
-                )
-            if oc.max_training_steps is not None and global_step // accum >= oc.max_training_steps:
-                stop = True
-                break
+        batch_iter = prefetch_to_device(
+            train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed + epoch),
+            lambda b: shard_batch(b, mesh),
+        )
+        try:
+            for batch, _ in batch_iter:
+                state, loss = train_step(state, batch, rng)
+                global_step += 1
+                window_losses.append(loss)
+                if global_step % log_every == 0:
+                    log_record(
+                        {
+                            "split": str(Split.TRAIN),
+                            "epoch": epoch,
+                            "step": global_step,
+                            "train_loss": float(jnp.mean(jnp.stack(window_losses))),
+                            "lr": float(lr_schedule(global_step // accum)),
+                        }
+                    )
+                    window_losses = []
+                if global_step % ckpt_every == 0:
+                    ckpt_mgr.save(
+                        global_step,
+                        serialization.to_state_dict(jax.device_get(state)),
+                        metadata={"epoch": epoch, "epoch_complete": False},
+                    )
+                if oc.max_training_steps is not None and global_step // accum >= oc.max_training_steps:
+                    stop = True
+                    break
+        finally:
+            batch_iter.close()
 
         tuning_metrics = evaluate(state.params, tuning_pyd, Split.TUNING)
         tuning_loss = tuning_metrics.get("tuning_loss", float("nan"))

@@ -1,4 +1,4 @@
-"""Hyperparameter sweep launcher (local random search).
+"""Hyperparameter sweep launcher (local random search + ASHA early stopping).
 
 Rebuild of ``/root/reference/scripts/launch_wandb_hp_sweep.py``: the same
 sweep-config dialect (nested parameter groups with ``value`` / ``values`` /
@@ -9,6 +9,15 @@ bayes sweep the launcher samples ``n_trials`` random configurations and
 either writes the pretrain command list (default) or runs them in-process
 (``--run``). The sweep objective name (``tuning_loss``) is preserved so
 result ranking works the same way.
+
+The reference sweep's hyperband ``early_terminate`` block
+(``/root/reference/configs/hyperparameter_sweep_base.yaml``) is implemented
+as **ASHA** over epochs: with ``early_terminate: {type: hyperband, min_iter,
+eta}`` present, ``--run`` executes trials rung by rung (``min_iter * eta^k``
+epochs), keeping only the top ``1/eta`` of surviving trials after each rung.
+Rungs resume from the orbax step checkpoints (the trial's LR schedule is
+pinned to its full horizon up front, so a promoted trial is bitwise the run
+it would have been without early stopping).
 
 Usage::
 
@@ -86,6 +95,121 @@ def sample_trial(parameters: dict[str, dict], rng: np.random.Generator) -> dict[
     return {k: sample_param(spec, rng) for k, spec in parameters.items()}
 
 
+def _trial_args(trial: dict[str, Any], extra: dict[str, Any] | None = None) -> list[str]:
+    merged = {**trial, **(extra or {})}
+    return [
+        f"{k}={json.dumps(v) if not isinstance(v, str) else v}"
+        for k, v in merged.items()
+        if v is not None
+    ]
+
+
+def _full_horizon(trial: dict[str, Any]) -> tuple[int, int]:
+    """(full max_epochs, full max_training_steps) for a trial.
+
+    The LR schedule must see the trial's *full* horizon at every rung —
+    otherwise a promoted trial's warmup/decay would differ from the
+    uninterrupted run and rung losses would not be comparable. Replicates
+    ``OptimizationConfig.set_to_dataset``'s ``ceil(len/batch) * max_epochs``.
+    """
+    import math
+
+    from eventstreamgpt_tpu.data import JaxDataset, PytorchDatasetConfig
+    from eventstreamgpt_tpu.models.config import OptimizationConfig
+
+    oc_defaults = OptimizationConfig()
+    max_epochs = int(trial.get("optimization_config.max_epochs", oc_defaults.max_epochs))
+    batch_size = int(trial.get("optimization_config.batch_size", oc_defaults.batch_size))
+
+    dc_kwargs = {
+        k.split(".", 1)[1]: v for k, v in trial.items() if k.startswith("data_config.")
+    }
+    ds = JaxDataset(PytorchDatasetConfig(**dc_kwargs), "train")
+    steps_per_epoch = int(math.ceil(len(ds) / batch_size))
+    return max_epochs, steps_per_epoch * max_epochs
+
+
+def run_asha(
+    trials: list[dict[str, Any]],
+    cfg: dict[str, Any],
+    sweep_dir: Path,
+    pretrain_main,
+) -> list[dict[str, Any]]:
+    """ASHA over epochs: run rungs, keep top 1/eta, resume survivors."""
+    et = cfg["early_terminate"]
+    if et.get("type") != "hyperband":
+        raise ValueError(f"Unsupported early_terminate type: {et.get('type')}")
+    eta = int(et.get("eta", 3))
+    min_iter = max(int(et.get("min_iter", 1)), 1)
+    metric_name = cfg["metric"]["name"]
+    # goal: minimize (default) or maximize — promotion must follow it.
+    goal = cfg["metric"].get("goal", "minimize")
+    if goal not in ("minimize", "maximize"):
+        raise ValueError(f"Unsupported metric goal: {goal}")
+    sign = 1.0 if goal == "minimize" else -1.0
+
+    def rank_key(t):
+        v = state[t][metric_name]
+        return sign * v if v is not None else float("inf")
+
+    state = [
+        {
+            "trial": t,
+            **trial,
+            metric_name: None,
+            "epochs_trained": 0,
+            "status": "alive",
+            "rungs": [],
+        }
+        for t, trial in enumerate(trials)
+    ]
+    horizons = [_full_horizon(trial) for trial in trials]
+
+    alive = list(range(len(trials)))
+    rung = 0
+    while alive:
+        target_epochs = min_iter * eta**rung
+        for t in alive:
+            full_epochs, full_steps = horizons[t]
+            run_to = min(target_epochs, full_epochs)
+            print(f"--- ASHA rung {rung}: trial {t} -> epoch {run_to}/{full_epochs} ---")
+            tuning_loss, _, _ = pretrain_main(
+                _trial_args(
+                    trials[t],
+                    {
+                        "optimization_config.max_epochs": run_to,
+                        "optimization_config.max_training_steps": full_steps,
+                        "do_resume_from_checkpoint": True,
+                        "do_overwrite": True,
+                    },
+                )
+            )
+            state[t][metric_name] = tuning_loss
+            state[t]["epochs_trained"] = run_to
+            state[t]["rungs"].append({"rung": rung, "epochs": run_to, metric_name: tuning_loss})
+            if run_to >= full_epochs:
+                state[t]["status"] = "completed"
+
+        alive = [t for t in alive if state[t]["status"] == "alive"]
+        if not alive:
+            break
+        # Promote the top ceil(len/eta) by the metric; kill the rest.
+        order = sorted(alive, key=rank_key)
+        n_keep = max((len(order) + eta - 1) // eta, 1)
+        for t in order[n_keep:]:
+            state[t]["status"] = f"stopped_rung_{rung}"
+        alive = order[:n_keep]
+        rung += 1
+
+    results = sorted(
+        state,
+        key=lambda r: sign * r[metric_name] if r[metric_name] is not None else float("inf"),
+    )
+    (sweep_dir / "sweep_results.json").write_text(json.dumps(results, indent=2))
+    print(f"Best trial: {results[0]}")
+    return results
+
+
 def main(argv: list[str] | None = None):
     argv = list(sys.argv[1:] if argv is None else argv)
     do_run = "--run" in argv
@@ -124,12 +248,13 @@ def main(argv: list[str] | None = None):
     if do_run:
         from .pretrain import main as pretrain_main
 
+        if cfg.get("early_terminate"):
+            return run_asha(trials, cfg, sweep_dir, pretrain_main)
+
         results = []
         for t, trial in enumerate(trials):
             print(f"--- sweep trial {t} ---")
-            trial_args = [f"{k}={json.dumps(v) if not isinstance(v, str) else v}"
-                          for k, v in trial.items() if v is not None]
-            tuning_loss, _, _ = pretrain_main(trial_args)
+            tuning_loss, _, _ = pretrain_main(_trial_args(trial))
             results.append({"trial": t, cfg["metric"]["name"]: tuning_loss, **trial})
         results.sort(key=lambda r: r.get(cfg["metric"]["name"]) or float("inf"))
         (sweep_dir / "sweep_results.json").write_text(json.dumps(results, indent=2))

@@ -6,11 +6,20 @@ shared with the driver's multi-chip dry run (``__graft_entry__.py``): env vars
 alone are not enough here because the environment's sitecustomize imports jax
 and registers the TPU plugin before this file runs, so the platform must also
 be forced via ``jax.config`` after import.
+
+Set ``ESGPT_TEST_PLATFORM=tpu`` to keep the real TPU backend instead — used
+to run the TPU-gated Pallas kernel parity tests (tests/test_pallas_attention.py)
+on hardware:
+
+    ESGPT_TEST_PLATFORM=tpu python -m pytest tests/test_pallas_attention.py -k KernelParity
 """
 
-from __graft_entry__ import _provision_cpu_devices
+import os
 
-_provision_cpu_devices(8)
+if os.environ.get("ESGPT_TEST_PLATFORM") != "tpu":
+    from __graft_entry__ import _provision_cpu_devices
+
+    _provision_cpu_devices(8)
 
 import jax  # noqa: E402
 

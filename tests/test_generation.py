@@ -20,6 +20,9 @@ from eventstreamgpt_tpu.models.ci_model import CIPPTForGenerativeSequenceModelin
 from eventstreamgpt_tpu.models.config import StructuredTransformerConfig
 from eventstreamgpt_tpu.models.na_model import NAPPTForGenerativeSequenceModeling
 
+pytestmark = pytest.mark.slow  # full e2e; excluded from the fast core loop (-m "not slow")
+
+
 # Vocab: event_type [1, 4), multi_lab [4, 8), lab_vals [8, 12).
 MEASUREMENT_CONFIGS = {
     "multi_lab": MeasurementConfig(

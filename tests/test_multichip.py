@@ -16,6 +16,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from __graft_entry__ import _make_model_and_batch
 
+pytestmark = pytest.mark.slow  # full e2e; excluded from the fast core loop (-m "not slow")
+
+
 
 def shard_inputs(batch, params, *extra_replicated):
     """Distribute a batch over the data axis of an 8-device mesh; replicate

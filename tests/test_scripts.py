@@ -20,6 +20,9 @@ from scripts.launch_hp_sweep import collapse_cfg, main as sweep_main, sample_par
 from scripts.prepare_pretrain_subsets import main as subsets_main
 from scripts.pretrain import main as pretrain_main
 
+pytestmark = pytest.mark.slow  # full e2e; excluded from the fast core loop (-m "not slow")
+
+
 RAW = Path("/root/reference/sample_data/raw")
 
 DATASET_YAML = """

@@ -1,0 +1,88 @@
+"""Mesh-sharded generation correctness (VERDICT r02 missing #1 / next #5).
+
+``generate(..., mesh=...)`` shards the (num_return_sequences-expanded) batch
+over a ``data`` mesh with replicated params. On the virtual 8-device CPU mesh
+(conftest.py) the sharded run must reproduce the single-device run: the
+per-row math is unchanged — sharding only partitions the batch axis — so
+sampled trajectories must match.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from __graft_entry__ import _make_model_and_batch
+from eventstreamgpt_tpu.generation import generate
+
+
+@pytest.fixture(scope="module")
+def model_setup():
+    model, batch = _make_model_and_batch(batch_size=4, seq_len=8, n_data=4, hidden=32, vocab=32)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    return model, params, batch
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("data",))
+
+
+class TestShardedGeneration:
+    @pytest.mark.parametrize("use_cache", [True, False])
+    def test_sharded_equals_single_device(self, model_setup, use_cache):
+        model, params, batch = model_setup
+        key = jax.random.PRNGKey(7)
+        kwargs = dict(max_new_events=4, num_return_sequences=2, use_cache=use_cache)
+
+        single = generate(model, params, batch, model.config, key, **kwargs)
+        sharded = generate(model, params, batch, model.config, key, mesh=_mesh(8), **kwargs)
+
+        np.testing.assert_array_equal(
+            np.asarray(single.event_mask), np.asarray(sharded.event_mask)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(single.dynamic_indices), np.asarray(sharded.dynamic_indices)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(single.dynamic_measurement_indices),
+            np.asarray(sharded.dynamic_measurement_indices),
+        )
+        np.testing.assert_allclose(
+            np.asarray(single.time_delta), np.asarray(sharded.time_delta), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(single.dynamic_values), np.asarray(sharded.dynamic_values), rtol=1e-5, atol=1e-6
+        )
+
+    def test_indivisible_batch_rejected(self, model_setup):
+        model, params, batch = model_setup
+        with pytest.raises(ValueError, match="must divide"):
+            generate(
+                model,
+                params,
+                batch.slice(slice(0, 3)),
+                model.config,
+                jax.random.PRNGKey(0),
+                max_new_events=2,
+                num_return_sequences=1,
+                mesh=_mesh(8),
+            )
+
+    def test_output_stays_gatherable(self, model_setup):
+        """Sharded outputs convert to host numpy without error (the labeler /
+        parquet-writer surface)."""
+        model, params, batch = model_setup
+        out = generate(
+            model,
+            params,
+            batch,
+            model.config,
+            jax.random.PRNGKey(1),
+            max_new_events=2,
+            num_return_sequences=2,
+            mesh=_mesh(8),
+        )
+        assert np.asarray(out.dynamic_indices).shape[0] == 8
+        for sample in out.split_repeated_batch(2):
+            assert sample.batch_size == 4

@@ -1,0 +1,114 @@
+"""ASHA early-termination sweep tests (VERDICT r02 missing #2 / next #7).
+
+With an ``early_terminate: {type: hyperband, min_iter, eta}`` block (the
+reference sweep's hyperband capability), ``--run`` executes trials rung by
+rung over ``tuning_loss`` and kills underperformers at each rung. The e2e
+test runs a 3-trial sweep on the sample data and asserts that losers are
+stopped at the first rung — trained for min_iter epochs only — while the
+survivor trains to its full horizon through checkpoint resume.
+"""
+
+import json
+import shutil
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from scripts.launch_hp_sweep import main as sweep_main
+
+pytestmark = pytest.mark.slow  # full e2e; excluded from the fast core loop (-m "not slow")
+
+REF_SAMPLE = Path("/root/reference/sample_data/processed/sample")
+
+SWEEP_YAML = """
+program: pretrain.py
+method: random
+name: asha_test_sweep
+n_trials: 3
+seed: 1
+sweep_dir: "{sweep_dir}"
+metric:
+  goal: minimize
+  name: tuning_loss
+early_terminate:
+  type: hyperband
+  min_iter: 1
+  eta: 3
+parameters:
+  config:
+    hidden_size: {{ value: 32 }}
+    head_dim: {{ value: 8 }}
+    num_attention_heads: {{ value: 4 }}
+    num_hidden_layers: {{ value: 2 }}
+    intermediate_size: {{ value: 32 }}
+    TTE_generation_layer_type: {{ value: log_normal_mixture }}
+    TTE_lognormal_generation_num_components: {{ value: 2 }}
+  optimization_config:
+    init_lr: {{ distribution: log_uniform_values, min: 1.0e-4, max: 1.0e-2 }}
+    max_epochs: {{ value: 3 }}
+    batch_size: {{ value: 4 }}
+    validation_batch_size: {{ value: 4 }}
+    lr_frac_warmup_steps: {{ value: 0.1 }}
+  data_config:
+    save_dir: {{ value: "{data_dir}" }}
+    max_seq_len: {{ value: 16 }}
+    min_seq_len: {{ value: 2 }}
+"""
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    dst = tmp_path_factory.mktemp("asha_sample_ds")
+    for name in ("vocabulary_config.json", "inferred_measurement_configs.json"):
+        shutil.copy(REF_SAMPLE / name, dst / name)
+    shutil.copytree(REF_SAMPLE / "DL_reps", dst / "DL_reps")
+    # The reference cache ships no train split; reuse tuning as train.
+    shutil.copy(dst / "DL_reps" / "tuning_0.parquet", dst / "DL_reps" / "train_0.parquet")
+    return dst
+
+
+class TestASHASweep:
+    def test_underperformers_killed_at_first_rung(self, data_dir, tmp_path):
+        sweep_dir = tmp_path / "sweep"
+        yaml_fp = tmp_path / "sweep.yaml"
+        yaml_fp.write_text(SWEEP_YAML.format(sweep_dir=sweep_dir, data_dir=data_dir))
+
+        results = sweep_main(["--run", "--config", str(yaml_fp)])
+
+        assert len(results) == 3
+        by_status = {}
+        for r in results:
+            by_status.setdefault(
+                "completed" if r["status"] == "completed" else "stopped", []
+            ).append(r)
+
+        # eta=3 with 3 alive trials: exactly ceil(3/3)=1 promoted past rung 0.
+        stopped = by_status.get("stopped", [])
+        assert len(stopped) == 2, results
+        for r in stopped:
+            assert r["status"] == "stopped_rung_0"
+            assert r["epochs_trained"] == 1  # min_iter epochs only
+        survivor = by_status["completed"][0]
+        assert survivor["epochs_trained"] == 3  # full horizon via resume
+        assert len(survivor["rungs"]) >= 2
+
+        # The survivor is the rung-0 best (ASHA promotion rule).
+        rung0 = {r["trial"]: r["rungs"][0]["tuning_loss"] for r in results}
+        assert survivor["trial"] == min(rung0, key=rung0.get)
+
+        # Results file exists and is ranked by the metric.
+        on_disk = json.loads((sweep_dir / "sweep_results.json").read_text())
+        losses = [r["tuning_loss"] for r in on_disk if r["tuning_loss"] is not None]
+        assert losses == sorted(losses)
+        assert all(np.isfinite(l) for l in losses)
+
+        # Every trial's rung-0 losses are comparable: all rungs were run with
+        # the same pinned full-horizon LR schedule (max_training_steps).
+        steps = set()
+        for r in results:
+            cfg_fp = Path(r["save_dir"]) / "optimization_config.json"
+            oc = json.loads(cfg_fp.read_text())
+            assert oc["max_epochs"] in (1, 3)
+            steps.add(oc["max_training_steps"])
+        assert len(steps) == 1

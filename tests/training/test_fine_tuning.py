@@ -21,11 +21,14 @@ from eventstreamgpt_tpu.models.config import OptimizationConfig, StructuredTrans
 from eventstreamgpt_tpu.models.fine_tuning_model import ESTForStreamClassification
 from eventstreamgpt_tpu.training import build_model, load_pretrained, save_pretrained
 from eventstreamgpt_tpu.training.fine_tuning import (
+
     FinetuneConfig,
     StreamClassificationMetrics,
     init_from_pretrained_encoder,
     train,
 )
+
+pytestmark = pytest.mark.slow  # full e2e; excluded from the fast core loop (-m "not slow")
 
 REF_SAMPLE = Path("/root/reference/sample_data/processed/sample")
 

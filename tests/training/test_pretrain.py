@@ -33,6 +33,8 @@ from eventstreamgpt_tpu.training import (
     train,
 )
 
+pytestmark = pytest.mark.slow  # full e2e; excluded from the fast core loop (-m "not slow")
+
 REF_SAMPLE = Path("/root/reference/sample_data/processed/sample")
 
 MODEL_KWARGS = dict(

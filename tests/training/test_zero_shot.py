@@ -39,9 +39,13 @@ MODEL_KWARGS = dict(
     max_seq_len=24,  # dataset max_seq_len 16 → 8 generated events
 )
 
+pytestmark = pytest.mark.slow  # full e2e; excluded from the fast core loop (-m "not slow")
+
 LABELER_SOURCE = '''
 import numpy as np
 from eventstreamgpt_tpu.models.zero_shot_labeler import Labeler
+
+
 
 class TaskLabeler(Labeler):
     """Labels True iff any generated event carries an even dynamic index."""
