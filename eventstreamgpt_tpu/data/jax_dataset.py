@@ -676,35 +676,14 @@ class JaxDataset(SeedableMixin, TimeableMixin):
 
     # -------------------------------------------------------------- batching
     # ------------------------------------------------------------- packing
-    def packed_batches(
-        self,
-        batch_size: int,
-        seq_len: int | None = None,
-        shuffle: bool = True,
-        seed: int | None = None,
-    ):
-        """Yields packed long-context batches with per-event ``segment_ids``.
+    def _pack_rows(self, L: int, rng: np.random.Generator, order: np.ndarray):
+        """First-fit packs subject (sub)sequences into rows of ``L`` events.
 
-        The long-context path (SURVEY §5.7; BASELINE config 5): instead of one
-        right/left-padded subject per row, whole subject sequences are
-        greedily first-fit packed into rows of ``seq_len`` (default
-        ``config.max_seq_len``), with ``segment_ids`` marking subject
-        boundaries. Attention, temporal encoding, and next-event alignment
-        are segment-aware in the CI model, so padding waste drops from
-        ``1 - mean_len/max_len`` to near zero at long sequence lengths.
-
-        Subjects longer than ``seq_len`` are cropped by the configured
-        subsequence-sampling strategy. Static data and stream labels are
-        per-subject, not per-row, and are omitted from packed batches (the
-        packed path targets generative pretraining throughput).
+        Returns ``[(subject, start, n_events), ...]`` per row. Deterministic
+        given the rng state and order (`packed_batch_count` relies on this to
+        predict `packed_batches`' stream exactly).
         """
-        L = seq_len or self.max_seq_len
-        M = self.max_n_dynamic
         d = self.data
-        n = len(self)
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(n) if shuffle else np.arange(n)
-
         strategy = self.config.subsequence_sampling_strategy
 
         # Greedy first-fit packing over a bounded set of open rows: unbounded
@@ -746,6 +725,56 @@ class JaxDataset(SeedableMixin, TimeableMixin):
             open_rows = [r for r in open_rows if row_fill[r] + min_len <= L]
             if len(open_rows) > MAX_OPEN_ROWS:
                 open_rows = open_rows[-MAX_OPEN_ROWS:]
+        return rows
+
+    def packed_batch_count(
+        self,
+        batch_size: int,
+        seq_len: int | None = None,
+        shuffle: bool = True,
+        seed: int | None = None,
+    ) -> int:
+        """Number of **full** batches `packed_batches` will yield.
+
+        Runs only the packing (no collation), so step budgets and LR
+        schedules can be derived from the packed stream before training
+        (packing several subjects per row makes the per-epoch batch count a
+        packing-factor smaller than the padded count).
+        """
+        L = seq_len or self.max_seq_len
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(self)) if shuffle else np.arange(len(self))
+        return len(self._pack_rows(L, rng, order)) // batch_size
+
+    def packed_batches(
+        self,
+        batch_size: int,
+        seq_len: int | None = None,
+        shuffle: bool = True,
+        seed: int | None = None,
+    ):
+        """Yields packed long-context batches with per-event ``segment_ids``.
+
+        The long-context path (SURVEY §5.7; BASELINE config 5): instead of one
+        right/left-padded subject per row, whole subject sequences are
+        greedily first-fit packed into rows of ``seq_len`` (default
+        ``config.max_seq_len``), with ``segment_ids`` marking subject
+        boundaries. Attention, temporal encoding, and next-event alignment
+        are segment-aware in the CI model, so padding waste drops from
+        ``1 - mean_len/max_len`` to near zero at long sequence lengths.
+
+        Subjects longer than ``seq_len`` are cropped by the configured
+        subsequence-sampling strategy. Static data and stream labels are
+        per-subject, not per-row, and are omitted from packed batches (the
+        packed path targets generative pretraining throughput).
+        """
+        L = seq_len or self.max_seq_len
+        M = self.max_n_dynamic
+        d = self.data
+        n = len(self)
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        rows = self._pack_rows(L, rng, order)
 
         def materialize(row_placements) -> dict:
             event_ids = np.zeros(L, dtype=np.int64)

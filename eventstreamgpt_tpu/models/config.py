@@ -425,9 +425,9 @@ class StructuredTransformerConfig(JSONableMixin):
         self.dep_graph_attention_layers = dep_graph_attention_layers
 
         self.seq_window_size = seq_window_size
-        if attention_implementation not in ("einsum", "pallas_flash"):
+        if attention_implementation not in ("einsum", "pallas_flash", "ring"):
             raise ValueError(
-                f"attention_implementation must be 'einsum' or 'pallas_flash'; got "
+                f"attention_implementation must be 'einsum', 'pallas_flash', or 'ring'; got "
                 f"{attention_implementation}"
             )
         self.attention_implementation = attention_implementation
@@ -716,12 +716,16 @@ class OptimizationConfig(JSONableMixin):
                 raise ValueError("Must set either end_lr or end_lr_frac_of_init_lr!")
             self.end_lr_frac_of_init_lr = self.end_lr / self.init_lr
 
-    def set_to_dataset(self, dataset) -> None:
+    def set_to_dataset(self, dataset, steps_per_epoch: int | None = None) -> None:
         """Derives ``max_training_steps`` / warmup steps from dataset length.
 
-        Reference: ``transformer/config.py:277-311``.
+        Reference: ``transformer/config.py:277-311``. ``steps_per_epoch``
+        overrides the padded-batch count — packed-batch training fits several
+        subjects per row, so its per-epoch step count (and therefore the LR
+        schedule horizon) is a packing-factor smaller.
         """
-        steps_per_epoch = int(math.ceil(len(dataset) / self.batch_size))
+        if steps_per_epoch is None:
+            steps_per_epoch = int(math.ceil(len(dataset) / self.batch_size))
         if self.max_training_steps is None:
             self.max_training_steps = steps_per_epoch * self.max_epochs
         if self.lr_num_warmup_steps is None:

@@ -277,14 +277,17 @@ class InnerSelfAttention(nn.Module):
         # end (VERDICT r02 #4). Falls back to the einsum path whenever kernel
         # preconditions don't hold (KV cache, dep-graph static-kv, attention
         # dropout, attention-weight outputs, non-TPU backends).
-        kernel_ok = (
-            cfg.attention_implementation == "pallas_flash"
-            and jax.default_backend() == "tpu"
-            and layer_past is None
+        fused_ok = (
+            layer_past is None
             and not static_kv_first
             and not use_cache
             and not output_attentions
             and (float(cfg.attention_dropout) == 0.0 or not self.has_rng("dropout"))
+        )
+        kernel_ok = (
+            cfg.attention_implementation == "pallas_flash"
+            and jax.default_backend() == "tpu"
+            and fused_ok
             and S % 128 == 0
         )
         use_pallas = kernel_ok and self.attention_type == "global"
@@ -294,22 +297,51 @@ class InnerSelfAttention(nn.Module):
             and self.window_size is not None
             and self.window_size >= 1
         )
-        if use_pallas:
+        # Sequence-parallel ring attention: active when the training driver
+        # wraps its step in `parallel.ring_context(mesh)` and the config asks
+        # for it. Queries stay resident; kv blocks rotate over the `context`
+        # mesh axis (parallel/ring_attention.py). Falls back to einsum with
+        # no active context, so ring-configured checkpoints run anywhere.
+        ring_ctx = None
+        if cfg.attention_implementation == "ring" and fused_ok:
+            from ..parallel.context import current_ring_context
+
+            ring_ctx = current_ring_context()
+            if ring_ctx is not None and S % ring_ctx.mesh.shape[ring_ctx.axis_name] != 0:
+                ring_ctx = None
+
+        # All fused paths share one packed-segment convention: padding rides
+        # as its own segment id (-1), so padded queries attend only among
+        # padded keys (finite outputs, discarded by the event-mask zeroing
+        # between layers).
+        seg = None
+        if ring_ctx is not None or use_pallas or use_splash:
+            base_seg = (
+                segment_ids if segment_ids is not None else jnp.zeros((B, S), dtype=jnp.int32)
+            )
+            pad_mask = attention_mask if attention_mask is not None else jnp.ones((B, S), bool)
+            seg = jnp.where(pad_mask, base_seg.astype(jnp.int32), -1)
+
+        if ring_ctx is not None:
+            from ..parallel.ring_attention import ring_attention
+
+            window = self.window_size if self.attention_type == "local" else None
+            attn_output = ring_attention(
+                query,
+                key,
+                value,
+                seg,
+                mesh=ring_ctx.mesh,
+                axis_name=ring_ctx.axis_name,
+                data_axis=ring_ctx.data_axis,
+                window_size=window,
+            )
+            outputs = {"present_key_value": None}
+        elif use_pallas:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 SegmentIds,
                 flash_attention,
             )
-
-            # Padding rides as its own segment id (-1): padded queries attend
-            # only among padded keys (finite outputs, discarded by the
-            # event-mask zeroing between layers).
-            base_seg = (
-                segment_ids
-                if segment_ids is not None
-                else jnp.zeros((B, S), dtype=jnp.int32)
-            )
-            pad_mask = attention_mask if attention_mask is not None else jnp.ones((B, S), bool)
-            seg = jnp.where(pad_mask, base_seg.astype(jnp.int32), -1)
 
             # GPT-Neo lineage: logits are NOT scaled by 1/sqrt(head_dim).
             # bf16 q/k/v ride the MXU directly (the kernel accumulates its
@@ -331,14 +363,6 @@ class InnerSelfAttention(nn.Module):
             from jax.experimental.pallas.ops.tpu.splash_attention import (
                 splash_attention_mask as splash_mask,
             )
-
-            base_seg = (
-                segment_ids
-                if segment_ids is not None
-                else jnp.zeros((B, S), dtype=jnp.int32)
-            )
-            pad_mask = attention_mask if attention_mask is not None else jnp.ones((B, S), bool)
-            seg = jnp.where(pad_mask, base_seg.astype(jnp.int32), -1)
 
             # Reference local rule (transformer.py:109-118): k <= q and
             # k > q - window, i.e. LocalMask left span = window - 1, right 0

@@ -1,0 +1,41 @@
+"""Active ring-attention context for model integration.
+
+Flax modules don't carry device meshes; the training driver activates a
+`ring_context` around its jitted step, and `InnerSelfAttention` (with
+``config.attention_implementation == "ring"``) picks the mesh up here. With
+no active context the model falls back to the einsum path — so a
+ring-configured checkpoint still loads and runs on a single device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class RingContext:
+    mesh: Mesh
+    axis_name: str = "context"
+    data_axis: str | None = "data"
+
+
+_STATE = threading.local()
+
+
+def current_ring_context() -> RingContext | None:
+    return getattr(_STATE, "ctx", None)
+
+
+@contextlib.contextmanager
+def ring_context(mesh: Mesh, axis_name: str = "context", data_axis: str | None = "data"):
+    """Activates ring attention over ``mesh[axis_name]`` for enclosed traces."""
+    prev = current_ring_context()
+    _STATE.ctx = RingContext(mesh=mesh, axis_name=axis_name, data_axis=data_axis)
+    try:
+        yield
+    finally:
+        _STATE.ctx = prev
