@@ -15,6 +15,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..data.types import DataModality, EventStreamBatch
+from ..ops import segment_starts
 from .config import StructuredEventProcessingMode, StructuredTransformerConfig
 from .model_output import (
     GenerativeOutputLayerBase,
@@ -58,12 +59,10 @@ class ConditionallyIndependentGenerativeOutputLayer(GenerativeOutputLayerBase):
                 # Packed rows: a segment's first event is predicted from zeros
                 # (like position 0), never from the previous subject's last
                 # event encoding.
-                seg = batch.segment_ids
-                seg_start = jnp.concatenate(
-                    [jnp.ones_like(seg[:, :1], dtype=bool), seg[:, 1:] != seg[:, :-1]], axis=1
-                )
                 for_event_contents_prediction = jnp.where(
-                    seg_start[..., None], 0.0, for_event_contents_prediction
+                    segment_starts(batch.segment_ids)[..., None],
+                    0.0,
+                    for_event_contents_prediction,
                 )
 
         classification_out = self.get_classification_outputs(

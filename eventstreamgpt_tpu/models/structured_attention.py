@@ -20,6 +20,8 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops import segment_starts
+
 
 class StructuredAttention(nn.Module):
     """Wraps a sequence module and a dep-graph module (reference ``:7``).
@@ -41,6 +43,7 @@ class StructuredAttention(nn.Module):
         dep_graph_module_kwargs: dict[str, Any] | None = None,
         prepend_graph_with_history_embeddings: bool = True,
         update_last_graph_el_to_history_embedding: bool = True,
+        segment_ids: jnp.ndarray | None = None,  # (B, L): packed subjects
     ):
         seq_module_kwargs = seq_module_kwargs or {}
         dep_graph_module_kwargs = dep_graph_module_kwargs or {}
@@ -62,7 +65,12 @@ class StructuredAttention(nn.Module):
             if event_mask is not None:
                 per_event = jnp.where(event_mask[..., None], per_event, 0.0)
 
-            out = seq_mod(per_event, attention_mask=seq_attention_mask, **seq_module_kwargs)
+            out = seq_mod(
+                per_event,
+                attention_mask=seq_attention_mask,
+                segment_ids=segment_ids,
+                **seq_module_kwargs,
+            )
             if isinstance(out, tuple):
                 contextualized_events, seq_module_return_kwargs = out
             else:
@@ -80,6 +88,12 @@ class StructuredAttention(nn.Module):
                     (jnp.zeros_like(contextualized_events[:, :1, :]), contextualized_events[:, :-1, :]),
                     axis=1,
                 )
+                if segment_ids is not None:
+                    # Packed rows: a segment's first event has no history —
+                    # never the previous subject's last contextualized event.
+                    contextualized_history = jnp.where(
+                        segment_starts(segment_ids)[..., None], 0.0, contextualized_history
+                    )
                 dep_graph_seq = jnp.concatenate(
                     (contextualized_history[:, :, None, :], hidden_states), axis=2
                 )

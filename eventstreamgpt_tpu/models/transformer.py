@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..data.types import EventStreamBatch
+from ..ops import segment_starts
 from .config import StructuredTransformerConfig
 from .embedding import DataEmbeddingLayer
 from .structured_attention import StructuredAttention
@@ -125,10 +126,7 @@ def time_from_deltas(batch: EventStreamBatch) -> Array:
         # position is t at its segment's first event; t is nondecreasing
         # (deltas ≥ 0), so a running max over segment-start values forward-
         # fills the current segment's offset.
-        seg = batch.segment_ids
-        seg_start = jnp.concatenate(
-            [jnp.ones_like(seg[:, :1], dtype=bool), seg[:, 1:] != seg[:, :-1]], axis=1
-        )
+        seg_start = segment_starts(batch.segment_ids)
         offsets = jax.lax.cummax(jnp.where(seg_start, t, -jnp.inf), axis=1)
         t = t - offsets
     return t
@@ -760,10 +758,12 @@ class NestedAttentionPointProcessTransformer(nn.Module):
         dep_graph_el_generation_target: int | None = None,
     ) -> TransformerOutputWithPast:
         cfg = self.config
-        if batch is not None and batch.segment_ids is not None:
+        segment_ids = batch.segment_ids if batch is not None else None
+        if segment_ids is not None and (use_cache or past is not None):
             raise NotImplementedError(
-                "Packed (segment_ids) batches are only supported by the CI encoder; "
-                "the NA dep-graph attention path requires padded batches."
+                "Packed (segment_ids) batches do not support KV-cached NA decoding; "
+                "train/eval forwards handle packing (segment-aware seq attention + "
+                "history), generation requires padded batches."
             )
         if input_embeds is None:
             input_embeds = NestedAttentionPointProcessInputLayer(cfg, name="input_layer")(
@@ -829,6 +829,7 @@ class NestedAttentionPointProcessTransformer(nn.Module):
                 hidden_states,
                 seq_attention_mask=seq_attention_mask,
                 event_mask=event_mask,
+                segment_ids=segment_ids,
                 prepend_graph_with_history_embeddings=prepend_graph_with_history_embeddings,
                 update_last_graph_el_to_history_embedding=update_last_graph_el_to_history_embedding,
                 seq_module_kwargs=dict(

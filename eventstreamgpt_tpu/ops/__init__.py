@@ -12,6 +12,7 @@ from .tensor_ops import (  # noqa: F401
     measurement_index_normalization,
     safe_masked_max,
     safe_weighted_avg,
+    segment_starts,
     str_summary,
     weighted_loss,
 )

@@ -179,3 +179,29 @@ def measurement_index_normalization(measurement_indices: jnp.ndarray) -> jnp.nda
     denom = vals.sum(axis=-1, keepdims=True)
     denom = jnp.where(denom == 0, 1.0, denom)
     return vals / denom
+
+
+def segment_starts(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """True at each packed segment's first position.
+
+    The shared boundary idiom for packed (segment-ID) rows: position 0 starts
+    a segment, as does any position whose id differs from its predecessor.
+    Used by the temporal encoding (time restarts per segment), the CI
+    next-event shift (a segment's first event is predicted from zeros), and
+    the NA history embedding (no cross-subject history).
+
+    Examples:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> seg = jnp.asarray([[0, 0, 1, 1, 1], [0, 1, 1, 2, 2]])
+        >>> np.asarray(segment_starts(seg))
+        array([[ True, False,  True, False, False],
+               [ True,  True, False,  True, False]])
+    """
+    return jnp.concatenate(
+        [
+            jnp.ones_like(segment_ids[:, :1], dtype=bool),
+            segment_ids[:, 1:] != segment_ids[:, :-1],
+        ],
+        axis=1,
+    )

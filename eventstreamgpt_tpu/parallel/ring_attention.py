@@ -65,7 +65,6 @@ def ring_attention_shard(
     my_idx = jax.lax.axis_index(axis_name)
     B, H, S, D = q.shape
 
-    q32 = q.astype(jnp.float32)
     q_pos = my_idx * S + jnp.arange(S)
 
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
@@ -77,9 +76,11 @@ def ring_attention_shard(
         src = (my_idx - r) % n_shards
         kv_pos = src * S + jnp.arange(S)
 
+        # Operands stay in the input dtype (bf16 rides the MXU); accumulation
+        # and all softmax statistics are fp32 — the same convention as the
+        # model's einsum path.
         logits = jnp.einsum(
-            "bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
+            "bhqd,bhkd->bhqk", q, k_blk, preferred_element_type=jnp.float32
         )
         mask = _block_logits_mask(q_pos, kv_pos, seg, seg_blk, window_size)
         logits = jnp.where(mask[:, None], logits, MASK_VALUE)
@@ -90,7 +91,7 @@ def ring_attention_shard(
         p = jnp.exp(logits - new_m[..., None])
         l = l * correction + p.sum(axis=-1)
         o = o * correction[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32),
+            "bhqk,bhkd->bhqd", p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32,
         )
 
@@ -105,9 +106,9 @@ def ring_attention_shard(
     # Initial accumulators derive from q so they carry q's device-varying
     # axes — a plain constant would fail shard_map's vma check against the
     # scan body's (varying) outputs.
-    o0 = q32 * 0.0
-    m0 = q32[..., 0] * 0.0 + MASK_VALUE
-    l0 = q32[..., 0] * 0.0
+    o0 = q.astype(jnp.float32) * 0.0
+    m0 = o0[..., 0] + MASK_VALUE
+    l0 = o0[..., 0]
     (o, m, l, _, _, _), _ = jax.lax.scan(
         step, (o0, m0, l0, k, v, seg), jnp.arange(n_shards)
     )

@@ -321,6 +321,11 @@ def train(
     optimization_config = cfg.optimization_config
     data_config = cfg.data_config
 
+    # set_to_dataset overwrites max_seq_len with the dataset's per-subject
+    # cap; the constructor-set value is the user's intended *model* context
+    # length, which packed-row training must honor (packed rows hold several
+    # subjects, so their length legitimately exceeds the per-subject cap).
+    configured_max_seq_len = config.max_seq_len
     config.set_to_dataset(train_pyd)
 
     oc = optimization_config
@@ -339,7 +344,11 @@ def train(
     # packed row length (default: config.max_seq_len).
     n_cp = int(tc.get("context_parallel_shards") or 1)
     use_packed = bool(tc.get("use_packed_batches")) or n_cp > 1
-    packed_L = int(tc.get("packed_seq_len") or config.max_seq_len)
+    packed_L = int(tc.get("packed_seq_len") or configured_max_seq_len)
+    if use_packed:
+        # The saved config must reflect the true context length trained at
+        # (downstream generation budgets read config.max_seq_len).
+        config.max_seq_len = packed_L
     if n_cp > 1:
         if n_tp > 1:
             raise ValueError(
@@ -364,8 +373,11 @@ def train(
 
     # Packed rows hold several subjects, so the packed stream has a
     # packing-factor fewer batches per epoch than the padded count — the LR
-    # schedule and step budget must see the real count (packing only, no
-    # collation).
+    # schedule and step budget must see that count, not the padded one.
+    # Epoch 0's packing (packing only, no collation) sets the nominal
+    # horizon; later epochs repack under a different shuffle and may differ
+    # by a row or two, exactly like Lightning's estimated steps when a
+    # dataloader's length drifts.
     steps_per_epoch = (
         train_pyd.packed_batch_count(oc.batch_size, seq_len=packed_L, seed=cfg.seed)
         if use_packed

@@ -68,6 +68,7 @@ class TestPrecisionConfig:
 
 
 class TestCIMixedPrecision:
+    @pytest.mark.slow  # dual-model traces; the cheap contracts above stay in the core loop
     def test_params_stay_fp32_and_losses_agree(self, dataset):
         batch = dataset.collate_indices(np.arange(min(4, len(dataset))))
 
@@ -98,6 +99,7 @@ class TestCIMixedPrecision:
                     abs(float(d32[k])), 1.0
                 ), (head, k)
 
+    @pytest.mark.slow  # dual-model traces; the cheap contracts above stay in the core loop
     def test_train_step_keeps_fp32_params(self, dataset):
         batch = dataset.collate_indices(np.arange(min(4, len(dataset))))
         cfg16 = _ci_config(dataset, "bf16")

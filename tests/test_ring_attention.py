@@ -73,6 +73,7 @@ class TestRingAttentionOp:
             np.asarray(out)[:, :, real[0]], np.asarray(ref)[:, :, real[0]], rtol=2e-5, atol=2e-5
         )
 
+    @pytest.mark.slow  # differentiates the whole ring scan; heavy on CPU
     def test_grads_flow_through_ring(self):
         q, k, v, seg = random_inputs(seed=2, with_padding=False)
         mesh = make_mesh(2, 4)

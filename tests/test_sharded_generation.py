@@ -16,6 +16,8 @@ from jax.sharding import Mesh
 from __graft_entry__ import _make_model_and_batch
 from eventstreamgpt_tpu.generation import generate
 
+pytestmark = pytest.mark.slow  # full generate() traces; excluded from the fast core loop
+
 
 @pytest.fixture(scope="module")
 def model_setup():
