@@ -344,7 +344,12 @@ def train(
     # packed row length (default: config.max_seq_len).
     n_cp = int(tc.get("context_parallel_shards") or 1)
     use_packed = bool(tc.get("use_packed_batches")) or n_cp > 1
-    packed_L = int(tc.get("packed_seq_len") or configured_max_seq_len)
+    # Default packed row length: the larger of the configured model context
+    # and the dataset's per-subject cap — a model max_seq_len left at its
+    # class default must not shrink packed rows below the data cap, and an
+    # explicitly longer model context must be honored. packed_seq_len
+    # overrides outright.
+    packed_L = int(tc.get("packed_seq_len") or max(configured_max_seq_len, train_pyd.max_seq_len))
     if use_packed:
         # The saved config must reflect the true context length trained at
         # (downstream generation budgets read config.max_seq_len).
