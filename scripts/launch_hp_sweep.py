@@ -1,14 +1,17 @@
-"""Hyperparameter sweep launcher (local random search + ASHA early stopping).
+"""Hyperparameter sweep launcher (random/TPE search + ASHA early stopping).
 
 Rebuild of ``/root/reference/scripts/launch_wandb_hp_sweep.py``: the same
 sweep-config dialect (nested parameter groups with ``value`` / ``values`` /
 ``min``+``max`` [+ ``distribution: log_uniform_values``] leaves, collapsed to
 hydra dotted-override syntax by ``collapse_cfg``), but executed locally —
 this environment has no W&B service, so instead of registering a remote
-bayes sweep the launcher samples ``n_trials`` random configurations and
-either writes the pretrain command list (default) or runs them in-process
+bayes sweep the launcher samples ``n_trials`` configurations and either
+writes the pretrain command list (default) or runs them in-process
 (``--run``). The sweep objective name (``tuning_loss``) is preserved so
-result ranking works the same way.
+result ranking works the same way. ``method: bayes`` runs local **TPE**
+(Tree-structured Parzen Estimators) under ``--run``: after a random startup,
+each trial is proposed from density models of the good/bad observations —
+the adaptive-search capability the reference delegates to the W&B service.
 
 The reference sweep's hyperband ``early_terminate`` block
 (``/root/reference/configs/hyperparameter_sweep_base.yaml``) is implemented
@@ -95,6 +98,115 @@ def sample_trial(parameters: dict[str, dict], rng: np.random.Generator) -> dict[
     return {k: sample_param(spec, rng) for k, spec in parameters.items()}
 
 
+# ------------------------------------------------------------- bayes (TPE)
+TPE_STARTUP_TRIALS = 4
+TPE_GAMMA = 0.25
+TPE_CANDIDATES = 24
+
+
+def _tpe_numeric(spec, good_vals, bad_vals, rng):
+    """Propose a numeric value maximizing the TPE density ratio l(x)/g(x).
+
+    Kernel density over observed values (bandwidth = range / sqrt(n)), in log
+    space for log-uniform specs; candidates are drawn from the good-KDE and
+    scored against the bad-KDE — the standard Bergstra et al. (2011) TPE
+    recipe with independent per-parameter models.
+    """
+    lo, hi = spec["min"], spec["max"]
+    log_space = spec.get("distribution") == "log_uniform_values"
+    tf = np.log if log_space else (lambda x: np.asarray(x, dtype=float))
+    inv = np.exp if log_space else (lambda x: x)
+    lo_t, hi_t = float(tf(lo)), float(tf(hi))
+    span = hi_t - lo_t
+    if span <= 0:
+        # Degenerate (min == max) pins the parameter; legal in the dialect.
+        return sample_param(spec, rng)
+
+    # Both densities carry a uniform floor (a fraction of the uniform
+    # density over the range): where neither side has observations — e.g.
+    # at the boundaries, where clipping piles candidate mass — the ratio
+    # damps toward 1 instead of exploding and dragging proposals to the
+    # range edges.
+    eps = 0.25 / span
+
+    def bandwidth(n_obs):
+        # Cap at span/4: with one observation an uncapped span-wide kernel
+        # clips nearly every candidate onto the range boundaries.
+        return float(np.clip(span / np.sqrt(n_obs), span * 1e-3, span / 4.0))
+
+    def kde(obs, x):
+        obs = np.asarray(obs, dtype=float)
+        bw = bandwidth(len(obs))
+        d = (x[:, None] - obs[None, :]) / bw
+        return np.exp(-0.5 * d * d).sum(axis=1) / (len(obs) * bw) + eps
+
+    g_obs = tf(np.asarray(good_vals, dtype=float))
+    # Half the candidates come from the good KDE (exploitation), half
+    # uniform over the range (exploration + no boundary pileup from clips).
+    n_kde = TPE_CANDIDATES // 2
+    centers = g_obs[rng.integers(len(g_obs), size=n_kde)]
+    bw = bandwidth(len(g_obs))
+    cands = np.concatenate(
+        [
+            np.clip(centers + rng.normal(0.0, bw, size=n_kde), lo_t, hi_t),
+            rng.uniform(lo_t, hi_t, size=TPE_CANDIDATES - n_kde),
+        ]
+    )
+    score = kde(g_obs, cands) / kde(tf(np.asarray(bad_vals, dtype=float)), cands)
+    best = float(inv(cands[int(np.argmax(score))]))
+    if isinstance(lo, int) and isinstance(hi, int) and not log_space:
+        return int(round(np.clip(best, lo, hi)))
+    return float(np.clip(best, lo, hi))
+
+
+def _tpe_categorical(spec, good_vals, bad_vals, rng):
+    """Propose the category maximizing smoothed good/bad frequency ratio."""
+    choices = spec["values"]
+
+    def freq(vals):
+        counts = np.array([sum(1 for v in vals if v == c) for c in choices], dtype=float)
+        return (counts + 1.0) / (counts.sum() + len(choices))
+
+    ratio = freq(good_vals) / freq(bad_vals)
+    return choices[int(np.argmax(ratio))]
+
+
+def propose_tpe(
+    parameters: dict[str, dict],
+    history: list[tuple[dict[str, Any], float]],
+    rng: np.random.Generator,
+) -> dict[str, Any]:
+    """One configuration proposed by Tree-structured Parzen Estimators.
+
+    ``history`` is ``[(trial, loss), ...]`` with lower losses better (the
+    caller negates maximize-goal metrics). Falls back to random sampling
+    until ``TPE_STARTUP_TRIALS`` observations exist — the local stand-in for
+    the reference sweep's W&B ``method: bayes`` service.
+    """
+    done = [(t, l) for t, l in history if l is not None and np.isfinite(l)]
+    if len(done) < TPE_STARTUP_TRIALS:
+        return sample_trial(parameters, rng)
+    done.sort(key=lambda tl: tl[1])
+    # n_good < len(done) always holds for len >= 2, so bad is never empty.
+    n_good = max(int(np.ceil(TPE_GAMMA * len(done))), 1)
+    good, bad = done[:n_good], done[n_good:]
+
+    out = {}
+    for k, spec in parameters.items():
+        if "value" in spec:
+            out[k] = sample_param(spec, rng)
+            continue
+        g = [t.get(k) for t, _ in good if t.get(k) is not None]
+        b = [t.get(k) for t, _ in bad if t.get(k) is not None]
+        if not g or not b:
+            out[k] = sample_param(spec, rng)
+        elif "values" in spec:
+            out[k] = _tpe_categorical(spec, g, b, rng)
+        else:
+            out[k] = _tpe_numeric(spec, g, b, rng)
+    return out
+
+
 def _trial_args(trial: dict[str, Any], extra: dict[str, Any] | None = None) -> list[str]:
     merged = {**trial, **(extra or {})}
     return [
@@ -150,7 +262,8 @@ def run_asha(
 
     def rank_key(t):
         v = state[t][metric_name]
-        return sign * v if v is not None else float("inf")
+        # None and NaN (diverged trial) both rank last.
+        return sign * v if v is not None and np.isfinite(v) else float("inf")
 
     state = [
         {
@@ -203,7 +316,11 @@ def run_asha(
 
     results = sorted(
         state,
-        key=lambda r: sign * r[metric_name] if r[metric_name] is not None else float("inf"),
+        key=lambda r: (
+            sign * r[metric_name]
+            if r[metric_name] is not None and np.isfinite(r[metric_name])
+            else float("inf")
+        ),
     )
     (sweep_dir / "sweep_results.json").write_text(json.dumps(results, indent=2))
     print(f"Best trial: {results[0]}")
@@ -230,33 +347,63 @@ def main(argv: list[str] | None = None):
 
     parameters = collapse_cfg("", cfg["parameters"])
     rng = np.random.default_rng(seed)
+    use_tpe = do_run and cfg.get("method") == "bayes" and not cfg.get("early_terminate")
 
     commands = []
     trials = []
-    for t in range(n_trials):
-        trial = sample_trial(parameters, rng)
-        trial["save_dir"] = str(sweep_dir / f"trial_{t}")
-        trials.append(trial)
-        args = " ".join(f"{k}={shlex.quote(json.dumps(v) if not isinstance(v, str) else v)}"
-                        for k, v in trial.items() if v is not None)
-        commands.append(f"python -m scripts.pretrain {args}")
+    if not use_tpe:
+        # TPE proposes trials adaptively inside the run loop — pre-sampled
+        # configs would be written but never executed, which is worse than
+        # writing nothing; the executed trials land in sweep_trials.json
+        # after the run instead.
+        for t in range(n_trials):
+            trial = sample_trial(parameters, rng)
+            trial["save_dir"] = str(sweep_dir / f"trial_{t}")
+            trials.append(trial)
+            args = " ".join(f"{k}={shlex.quote(json.dumps(v) if not isinstance(v, str) else v)}"
+                            for k, v in trial.items() if v is not None)
+            commands.append(f"python -m scripts.pretrain {args}")
 
-    (sweep_dir / "sweep_trials.json").write_text(json.dumps(trials, indent=2))
-    (sweep_dir / "sweep_commands.sh").write_text("\n".join(commands) + "\n")
-    print(f"Wrote {n_trials} trial commands to {sweep_dir / 'sweep_commands.sh'}")
+        (sweep_dir / "sweep_trials.json").write_text(json.dumps(trials, indent=2))
+        (sweep_dir / "sweep_commands.sh").write_text("\n".join(commands) + "\n")
+        print(f"Wrote {n_trials} trial commands to {sweep_dir / 'sweep_commands.sh'}")
 
     if do_run:
         from .pretrain import main as pretrain_main
 
         if cfg.get("early_terminate"):
+            # Rungs need batches of comparable trials, so ASHA keeps random
+            # proposals; bayes (TPE) applies to the sequential path below.
             return run_asha(trials, cfg, sweep_dir, pretrain_main)
 
+        metric_name = cfg["metric"]["name"]
+        goal = cfg["metric"].get("goal", "minimize")
+        sign = 1.0 if goal == "minimize" else -1.0
+        history: list[tuple[dict[str, Any], float | None]] = []
+
+        def rank(r):
+            v = r.get(metric_name)
+            # Diverged (NaN) trials rank last, like missing ones — nan would
+            # otherwise poison the sort and could print as "Best trial".
+            return sign * v if v is not None and np.isfinite(v) else float("inf")
+
         results = []
-        for t, trial in enumerate(trials):
-            print(f"--- sweep trial {t} ---")
+        for t in range(n_trials):
+            if use_tpe:
+                # Adaptive search (the W&B bayes analog): propose from TPE
+                # fitted to the observed objective values so far.
+                trial = propose_tpe(parameters, history, rng)
+                trial["save_dir"] = str(sweep_dir / f"trial_{t}")
+                trials.append(trial)
+            else:
+                trial = trials[t]
+            print(f"--- sweep trial {t} ({cfg.get('method', 'random')}) ---")
             tuning_loss, _, _ = pretrain_main(_trial_args(trial))
-            results.append({"trial": t, cfg["metric"]["name"]: tuning_loss, **trial})
-        results.sort(key=lambda r: r.get(cfg["metric"]["name"]) or float("inf"))
+            history.append((trial, sign * tuning_loss if tuning_loss is not None else None))
+            results.append({"trial": t, metric_name: tuning_loss, **trial})
+        if use_tpe:
+            (sweep_dir / "sweep_trials.json").write_text(json.dumps(trials, indent=2))
+        results.sort(key=rank)
         (sweep_dir / "sweep_results.json").write_text(json.dumps(results, indent=2))
         print(f"Best trial: {results[0]}")
         return results
