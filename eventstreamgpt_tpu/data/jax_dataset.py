@@ -779,9 +779,10 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         right/left-padded subject per row, whole subject sequences are
         greedily first-fit packed into rows of ``seq_len`` (default
         ``config.max_seq_len``), with ``segment_ids`` marking subject
-        boundaries. Attention, temporal encoding, and next-event alignment
-        are segment-aware in the CI model, so padding waste drops from
-        ``1 - mean_len/max_len`` to near zero at long sequence lengths.
+        boundaries. Attention, temporal encoding, history embeddings, and
+        next-event alignment are segment-aware in both the CI and NA models,
+        so padding waste drops from ``1 - mean_len/max_len`` to near zero at
+        long sequence lengths.
 
         Subjects longer than ``seq_len`` are cropped by the configured
         subsequence-sampling strategy. Static data and stream labels are

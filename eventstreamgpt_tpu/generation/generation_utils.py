@@ -6,11 +6,15 @@ samplers ``:310-416``) as a function over flax models.
 
 Structure under XLA: the output batch is **preallocated** to
 ``input_len + max_new_events`` events and every step writes through a cursor,
-so each step is a fixed-shape jitted computation (compiled once for the
-cached single-event step; once more for the initial prefix pass). The CI path
-runs one forward per event; the NA path one forward per dependency-graph
-element per event, using the three-phase cache machine of
-`NestedAttentionPointProcessTransformer`.
+so each step is a fixed-shape jitted computation. On the common path (KV
+caches, no data-dependent stopping criteria) everything after the prefix
+pass runs **on device inside one ``lax.scan``** — the CI body is one forward
+per event, the NA body the full per-event level walk of the three-phase
+cache machine of `NestedAttentionPointProcessTransformer` — so the host
+dispatches two programs per generate() call regardless of horizon. With
+data-dependent stopping criteria (or ``use_cache=False``) the loop falls
+back to per-event Python dispatch. Jitted step closures are memoized per
+(model, shape) across generate() calls.
 
 Deliberate divergence: the reference's *uncached* NA generation slices input
 embeddings per dep-graph target, attending over a smaller key set than the
