@@ -90,14 +90,22 @@ class DevicePrefetcher:
         return self
 
     def __next__(self):
-        item = self._queue.get()
-        if item is _SENTINEL:
-            self.close()
-            raise StopIteration
-        if isinstance(item, BaseException):
-            self.close()
-            raise item
-        return item
+        # A closed (or exhausted) prefetcher terminates iteration instead of
+        # blocking forever on an empty queue; the timeout loop also covers a
+        # close() racing a blocked get().
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is _SENTINEL:
+                self.close()
+                raise StopIteration
+            if isinstance(item, BaseException):
+                self.close()
+                raise item
+            return item
+        raise StopIteration
 
     def close(self) -> None:
         self._stop.set()

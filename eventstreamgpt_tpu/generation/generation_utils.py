@@ -173,8 +173,8 @@ def generate(
             (``generation_utils.py:240-247``), minus the per-step all-reduce
             handshake (all shards run the same step count, so no peer can
             finish early). The expanded batch size
-            (``batch_size * num_return_sequences``) must divide the mesh's
-            device count.
+            (``batch_size * num_return_sequences``) must be divisible by the
+            mesh's ``data`` axis size.
 
     Returns:
         The completed `EventStreamBatch` of ``input_len + max_new_events``
@@ -191,11 +191,16 @@ def generate(
         batch = batch.repeat_batch_elements(num_return_sequences)
 
     if mesh is not None:
-        n_mesh = int(mesh.devices.size)
-        if batch.batch_size % n_mesh != 0:
+        if "data" not in mesh.shape:
+            raise ValueError(
+                f"generate() shards batches over a 'data' mesh axis; the given mesh has "
+                f"axes {tuple(mesh.axis_names)}."
+            )
+        n_data = int(mesh.shape["data"])
+        if batch.batch_size % n_data != 0:
             raise ValueError(
                 f"Expanded batch size {batch.batch_size} (batch x num_return_sequences) "
-                f"must divide the mesh device count ({n_mesh})."
+                f"must be divisible by the mesh's 'data' axis size ({n_data})."
             )
 
         def _shard_leaf(x):
@@ -269,22 +274,30 @@ def _should_stop(big, cursor, stopping_criteria) -> bool:
 # generate() runs per batch inside eval loops; rebuilding its @jax.jit
 # closures on every call would give each call a fresh (empty) trace cache and
 # re-trace the model each time — seconds of pure overhead per batch. Step
-# closures are therefore memoized per (mode, model identity, shape
-# signature). Entries hold a strong reference to the model so a cached id
-# cannot be recycled; the cache is FIFO-bounded (one entry per distinct
-# generation shape — a handful per process).
+# closures are therefore memoized per (mode, config signature, shape
+# signature): a flax module's apply() is a pure function of its config, so
+# callers that build a fresh model object per generate() call still hit the
+# cache (the cached closures keep the first equivalent instance alive). The
+# cache is FIFO-bounded (one entry per distinct generation shape — a handful
+# per process).
 _STEP_CACHE: dict[tuple, dict] = {}
 _STEP_CACHE_MAX = 32
 
 
-def _cached_steps(cache_key: tuple, model, build):
+def _config_signature(config: StructuredTransformerConfig) -> str:
+    import json
+
+    return json.dumps(config.to_dict(), sort_keys=True, default=str)
+
+
+def _cached_steps(cache_key: tuple, build):
     hit = _STEP_CACHE.get(cache_key)
-    if hit is not None and hit["model"] is model:
-        return hit["steps"]
+    if hit is not None:
+        return hit
     steps = build()
     if len(_STEP_CACHE) >= _STEP_CACHE_MAX:
         _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
-    _STEP_CACHE[cache_key] = {"model": model, "steps": steps}
+    _STEP_CACHE[cache_key] = steps
     return steps
 
 
@@ -370,8 +383,7 @@ def _generate_ci(
     cursor = jnp.asarray(input_len, jnp.int32)
 
     steps = _cached_steps(
-        ("ci", id(model), B, input_len, max_new_events),
-        model,
+        ("ci", _config_signature(config), B, input_len, max_new_events),
         lambda: _build_ci_steps(model, config, B, input_len, max_new_events),
     )
     prefix_step = steps["prefix_step"]
@@ -535,8 +547,7 @@ def _generate_na(
     cursor = jnp.asarray(input_len, jnp.int32)
 
     steps = _cached_steps(
-        ("na", id(model), B, input_len, max_new_events),
-        model,
+        ("na", _config_signature(config), B, input_len, max_new_events),
         lambda: _build_na_steps(model, config, B, input_len, max_new_events),
     )
     measurements_to_fill_list = steps["measurements_to_fill_list"]

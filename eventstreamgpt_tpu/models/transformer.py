@@ -332,6 +332,7 @@ class InnerSelfAttention(nn.Module):
                 mesh=ring_ctx.mesh,
                 axis_name=ring_ctx.axis_name,
                 data_axis=ring_ctx.data_axis,
+                head_axis=ring_ctx.head_axis,
                 window_size=window,
             )
             outputs = {"present_key_value": None}

@@ -21,6 +21,9 @@ class RingContext:
     mesh: Mesh
     axis_name: str = "context"
     data_axis: str | None = "data"
+    # Mesh axis carrying Megatron head-split attention (training/sharding.py);
+    # ring_attention ignores it unless the mesh actually has it.
+    head_axis: str | None = "model"
 
 
 _STATE = threading.local()
@@ -31,10 +34,17 @@ def current_ring_context() -> RingContext | None:
 
 
 @contextlib.contextmanager
-def ring_context(mesh: Mesh, axis_name: str = "context", data_axis: str | None = "data"):
+def ring_context(
+    mesh: Mesh,
+    axis_name: str = "context",
+    data_axis: str | None = "data",
+    head_axis: str | None = "model",
+):
     """Activates ring attention over ``mesh[axis_name]`` for enclosed traces."""
     prev = current_ring_context()
-    _STATE.ctx = RingContext(mesh=mesh, axis_name=axis_name, data_axis=data_axis)
+    _STATE.ctx = RingContext(
+        mesh=mesh, axis_name=axis_name, data_axis=data_axis, head_axis=head_axis
+    )
     try:
         yield
     finally:

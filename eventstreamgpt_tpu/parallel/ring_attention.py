@@ -126,6 +126,7 @@ def ring_attention(
     axis_name: str = "context",
     data_axis: str | None = "data",
     window_size: int | None = None,
+    head_axis: str | None = "model",
 ):
     """Sequence-parallel attention over ``mesh[axis_name]``.
 
@@ -136,6 +137,13 @@ def ring_attention(
         mesh: mesh containing ``axis_name`` (and optionally ``data_axis``).
         data_axis: mesh axis sharding the batch dim, or None if replicated.
         window_size: optional sliding-window width.
+        head_axis: mesh axis sharding the head dim, or None. Attention is
+            per-head independent, so composing with Megatron tensor
+            parallelism (head-split q/k/v projections; ``training/sharding.py``)
+            needs no collectives over this axis — each shard rings its local
+            heads' kv blocks over ``axis_name`` only. Ignored when absent
+            from the mesh or when the head count doesn't divide it (the
+            heads then enter the ring replicated via an XLA all-gather).
 
     Returns:
         ``(B, H, S, D)`` attention output, sharded like ``q``.
@@ -146,7 +154,14 @@ def ring_attention(
             f"axis size ({mesh.shape[axis_name]})."
         )
     b_spec = data_axis if data_axis in mesh.shape else None
-    qkv_spec = P(b_spec, None, axis_name, None)
+    h_spec = (
+        head_axis
+        if head_axis is not None
+        and head_axis in mesh.shape
+        and q.shape[1] % mesh.shape[head_axis] == 0
+        else None
+    )
+    qkv_spec = P(b_spec, h_spec, axis_name, None)
     seg_spec = P(b_spec, axis_name)
 
     fn = jax.shard_map(

@@ -22,6 +22,7 @@ from .pretrain import (
     evaluate,
     make_eval_step,
     make_train_step,
+    parallel_mesh,
     replicate,
     shard_batch,
     train,
@@ -45,6 +46,7 @@ __all__ = [
     "make_mesh",
     "make_param_shardings",
     "make_train_step",
+    "parallel_mesh",
     "polynomial_decay_with_warmup",
     "replicate",
     "shard_params",

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.jax_dataset import JaxDataset
+from ..data.prefetch import prefetch_to_device
 from ..models.config import StructuredEventProcessingMode, StructuredTransformerConfig
 from ..models.transformer import (
     ConditionallyIndependentPointProcessTransformer,
@@ -103,13 +104,24 @@ def get_embeddings(cfg: FinetuneConfig) -> dict[str, Path]:
     for sp in ("train", "tuning", "held_out"):
         dataset = train_pyd if sp == "train" else JaxDataset(cfg.data_config, split=sp)
         chunks = []
-        for batch in dataset.batches(
-            oc.validation_batch_size, shuffle=False, drop_last=False, seed=0
-        ):
-            emb = np.asarray(embed_step(params, shard_batch(batch, mesh)))
-            if batch.valid_mask is not None:
-                emb = emb[np.asarray(batch.valid_mask)]
-            chunks.append(emb)
+        # Async input pipeline: collation + device_put overlap the previous
+        # batch's encoder forward. valid_mask is captured host-side in the
+        # worker so reading it here costs no device sync.
+        batch_iter = prefetch_to_device(
+            dataset.batches(oc.validation_batch_size, shuffle=False, drop_last=False, seed=0),
+            lambda b: shard_batch(b, mesh),
+            host_stats_fn=lambda b: (
+                np.asarray(b.valid_mask) if b.valid_mask is not None else None
+            ),
+        )
+        try:
+            for batch, valid in batch_iter:
+                emb = np.asarray(embed_step(params, batch))
+                if valid is not None:
+                    emb = emb[valid]
+                chunks.append(emb)
+        finally:
+            batch_iter.close()
         embeddings = np.concatenate(chunks, axis=0)
 
         embeddings_fp = out_dir / f"{sp}_embeddings.npy"

@@ -82,6 +82,57 @@ def build_model(config: StructuredTransformerConfig):
 
 
 # ------------------------------------------------------------------ sharding
+def _fit_data_axis(n_data: int, *batch_sizes: int) -> int:
+    """Largest data-axis size ≤ ``n_data`` that divides every batch size.
+
+    The shared fallback rule of every mesh builder: shrink the data axis
+    (rather than fail) so e.g. a batch of 6 on 4 chips runs 2-way
+    data-parallel.
+    """
+    while n_data > 1 and any(bs % n_data != 0 for bs in batch_sizes):
+        n_data -= 1
+    return max(n_data, 1)
+
+
+def parallel_mesh(*batch_sizes: int, n_cp: int = 1, n_tp: int = 1) -> Mesh:
+    """The training mesh for any ``data × context × model`` layout.
+
+    Axes of size 1 are omitted, so the degenerate layouts collapse to the
+    1-D ``data`` mesh, ``data × model`` (tensor parallel), or
+    ``data × context`` (ring attention). Axis order puts ``model`` innermost
+    (the highest-bandwidth links carry the per-layer TP all-reduces),
+    ``context`` next (ring kv rotations), ``data`` outermost. The data axis
+    shrinks until it divides every batch size (`_fit_data_axis`).
+    """
+    devices = jax.devices()
+    n_devices = len(devices)
+    per_data = n_cp * n_tp
+    if n_devices % per_data != 0:
+        raise ValueError(
+            f"context_parallel_shards x tensor_parallel_shards ({n_cp}x{n_tp}) must "
+            f"divide the device count ({n_devices}); a silent partial mesh would "
+            "waste devices."
+        )
+    n_data = _fit_data_axis(n_devices // per_data, *batch_sizes)
+    # The pure data-parallel shrink is documented quiet fallback behavior
+    # (data_parallel_mesh); only explicitly-requested TP/CP layouts warn
+    # about wasted devices.
+    if per_data > 1 and n_data * per_data < n_devices:
+        print(
+            f"WARNING: batch sizes {batch_sizes} shrink the data axis to {n_data}; "
+            f"using {n_data * per_data} of {n_devices} devices."
+        )
+    dims = [("data", n_data)]
+    if n_cp > 1:
+        dims.append(("context", n_cp))
+    if n_tp > 1:
+        dims.append(("model", n_tp))
+    return Mesh(
+        np.asarray(devices[: n_data * per_data]).reshape([s for _, s in dims]),
+        tuple(n for n, _ in dims),
+    )
+
+
 def data_parallel_mesh(*batch_sizes: int) -> Mesh:
     """A 1-D ``data`` mesh over the most devices that divide every batch size.
 
@@ -89,11 +140,7 @@ def data_parallel_mesh(*batch_sizes: int) -> Mesh:
     a batch of 6 on 4 chips runs 2-way data-parallel. Passing both the train
     and validation batch sizes yields one mesh usable for the whole run.
     """
-    devices = jax.devices()
-    n = len(devices)
-    while n > 1 and any(bs % n != 0 for bs in batch_sizes):
-        n -= 1
-    return Mesh(np.asarray(devices[:n]), ("data",))
+    return parallel_mesh(*batch_sizes)
 
 
 def shard_batch(batch: EventStreamBatch, mesh: Mesh) -> EventStreamBatch:
@@ -112,18 +159,7 @@ def context_parallel_mesh(n_cp: int, *batch_sizes: int) -> Mesh:
     The data axis takes the remaining devices, shrinking (like
     `data_parallel_mesh`) until it divides every batch size.
     """
-    devices = jax.devices()
-    n_devices = len(devices)
-    if n_devices % n_cp != 0:
-        raise ValueError(
-            f"context_parallel_shards={n_cp} must divide the device count ({n_devices})."
-        )
-    n_data = max(n_devices // n_cp, 1)
-    while n_data > 1 and any(bs % n_data != 0 for bs in batch_sizes):
-        n_data -= 1
-    return Mesh(
-        np.asarray(devices[: n_data * n_cp]).reshape(n_data, n_cp), ("data", "context")
-    )
+    return parallel_mesh(*batch_sizes, n_cp=n_cp)
 
 
 # Batch fields whose dim 1 is the event (sequence) axis; statics, labels,
@@ -144,11 +180,17 @@ _CP_SEQ_FIELDS = frozenset(
 
 def shard_batch_cp(batch: EventStreamBatch, mesh: Mesh) -> EventStreamBatch:
     """Device-puts a batch with the batch dim on ``data`` and the sequence
-    (event) dim on ``context`` — the layout ring attention consumes."""
+    (event) dim on ``context`` — the layout ring attention consumes.
+
+    Arrays whose event axis does not divide the ``context`` axis (e.g. padded
+    eval batches at the dataset's own cap) fall back to data-only sharding;
+    GSPMD reshards them at the first trace-enforced boundary instead.
+    """
+    n_ctx = int(mesh.shape["context"])
 
     def put(x, seq_sharded: bool):
         x = np.asarray(x)
-        if seq_sharded and x.ndim >= 2:
+        if seq_sharded and x.ndim >= 2 and x.shape[1] % n_ctx == 0:
             spec = P("data", "context", *([None] * (x.ndim - 2)))
         else:
             spec = P("data", *([None] * (x.ndim - 1)))
@@ -215,12 +257,16 @@ def evaluate(
     split: str,
     mesh: Mesh | None = None,
     key: jax.Array | None = None,
+    place_batch: Callable[[EventStreamBatch, Mesh], EventStreamBatch] | None = None,
 ) -> dict[str, float]:
     """Runs one full-split eval pass, returning ``{split}_...`` metrics.
 
     Fill rows in the final short batch are blanked + flagged by
     ``valid_mask``; loss parts re-weight by the valid count so no subject is
-    double-counted (VERDICT weak #5).
+    double-counted (VERDICT weak #5). ``place_batch`` overrides the default
+    data-sharded placement — context-parallel callers pass ``shard_batch_cp``
+    so the event axis lands on the ``context`` mesh axis up front instead of
+    being resharded at every ring-attention boundary.
     """
     metrics = GenerativeMetrics(config, metrics_config, split=split)
     if key is None:
@@ -228,7 +274,8 @@ def evaluate(
     # seed=0 pins the (otherwise random) subsequence crops so every eval pass
     # scores identical data — epoch-to-epoch tuning losses must be comparable
     # for early stopping, and the final validation must match the last epoch.
-    place = (lambda b: shard_batch(b, mesh)) if mesh is not None else (lambda b: b)
+    placer = place_batch if place_batch is not None else shard_batch
+    place = (lambda b: placer(b, mesh)) if mesh is not None else (lambda b: b)
     batch_iter = prefetch_to_device(
         dataset.batches(batch_size, shuffle=False, drop_last=False, seed=0),
         place,
@@ -355,11 +402,6 @@ def train(
         # (downstream generation budgets read config.max_seq_len).
         config.max_seq_len = packed_L
     if n_cp > 1:
-        if n_tp > 1:
-            raise ValueError(
-                "context_parallel_shards and tensor_parallel_shards cannot currently be "
-                "combined; pick one."
-            )
         if config.attention_implementation != "ring":
             raise ValueError(
                 "context_parallel_shards > 1 requires config.attention_implementation='ring' "
@@ -412,34 +454,19 @@ def train(
     model = build_model(config)
     tx, lr_schedule = build_optimizer(optimization_config)
 
+    # One mesh for every layout: data-parallel by default; a ``model`` axis
+    # for Megatron tensor parallelism; a ``context`` axis for ring-attention
+    # sequence parallelism; all three composed when both shard counts are set
+    # (the axes are orthogonal — each model shard rings its local heads' kv
+    # blocks over ``context``; parallel/ring_attention.py ``head_axis``).
+    mesh = parallel_mesh(oc.batch_size, oc.validation_batch_size, n_cp=n_cp, n_tp=n_tp)
     if n_tp > 1:
-        from .sharding import make_mesh, shard_state
+        from .sharding import shard_state
 
-        n_devices = len(jax.devices())
-        if n_devices % n_tp != 0:
-            raise ValueError(
-                f"tensor_parallel_shards={n_tp} must divide the device count ({n_devices}); "
-                "a silent partial mesh would waste devices."
-            )
-        n_data = max(n_devices // n_tp, 1)
-        while n_data > 1 and (oc.batch_size % n_data or oc.validation_batch_size % n_data):
-            n_data -= 1
-        if n_data * n_tp < n_devices:
-            print(
-                f"WARNING: batch sizes ({oc.batch_size}/{oc.validation_batch_size}) shrink "
-                f"the data axis to {n_data}; using {n_data * n_tp} of {n_devices} devices."
-            )
-        mesh = make_mesh(n_data, n_tp)
         place_state = lambda s: shard_state(s, mesh)  # noqa: E731
-        place_batch = shard_batch
-    elif n_cp > 1:
-        mesh = context_parallel_mesh(n_cp, oc.batch_size, oc.validation_batch_size)
-        place_state = lambda s: replicate(s, mesh)  # noqa: E731
-        place_batch = shard_batch_cp
     else:
-        mesh = data_parallel_mesh(oc.batch_size, oc.validation_batch_size)
         place_state = lambda s: replicate(s, mesh)  # noqa: E731
-        place_batch = shard_batch
+    place_batch = shard_batch_cp if n_cp > 1 else shard_batch
 
     def train_batches(epoch: int, skip: int):
         """The epoch's training batch stream (padded or packed)."""
@@ -624,6 +651,7 @@ def train(
                 Split.TUNING,
                 mesh=mesh,
                 key=eval_key,
+                place_batch=place_batch,
             )
             tuning_loss = tuning_metrics.get("tuning_loss", float("nan"))
             log_record(
@@ -682,6 +710,7 @@ def train(
         Split.TUNING,
         mesh=mesh,
         key=k1,
+        place_batch=place_batch,
     )
     final_held_out = evaluate(
         eval_step,
@@ -693,6 +722,7 @@ def train(
         Split.HELD_OUT,
         mesh=mesh,
         key=k2,
+        place_batch=place_batch,
     )
 
     if is_main:

@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from ..data.jax_dataset import JaxDataset
+from ..data.prefetch import prefetch_to_device
 from ..generation import generate
 from ..models.config import Split, StructuredTransformerConfig
 from ..models.zero_shot_labeler import Labeler
@@ -158,22 +159,33 @@ def zero_shot_evaluation(
     for split, dataset in ((Split.TUNING, tuning_pyd), (Split.HELD_OUT, held_out_pyd)):
         metrics = StreamClassificationMetrics(config, split)
         frac_unpredictable: list[np.ndarray] = []
-        for batch in dataset.batches(batch_size, shuffle=False, drop_last=False, seed=0):
-            key, sub = jax.random.split(key)
-            out, frac = get_generative_predictions(
-                model,
-                params,
-                config,
-                labeling_function,
-                batch,
-                sub,
-                num_samples=num_samples,
-                max_new_events=max_new_events,
-                mesh=mesh,
-            )
-            if len(out.labels):
-                metrics.update(out)
-            frac_unpredictable.append(frac)
+        # Collation runs in the prefetcher's worker thread, overlapping the
+        # (device-bound) generation of the previous batch. Placement stays on
+        # the host — generate() expands the batch by num_return_sequences
+        # before sharding it over the mesh itself.
+        batch_iter = prefetch_to_device(
+            dataset.batches(batch_size, shuffle=False, drop_last=False, seed=0),
+            lambda b: b,
+        )
+        try:
+            for batch, _ in batch_iter:
+                key, sub = jax.random.split(key)
+                out, frac = get_generative_predictions(
+                    model,
+                    params,
+                    config,
+                    labeling_function,
+                    batch,
+                    sub,
+                    num_samples=num_samples,
+                    max_new_events=max_new_events,
+                    mesh=mesh,
+                )
+                if len(out.labels):
+                    metrics.update(out)
+                frac_unpredictable.append(frac)
+        finally:
+            batch_iter.close()
         result = metrics.compute()
         result.pop(f"{split}_loss", None)  # zero-shot has no loss
         if frac_unpredictable:

@@ -221,8 +221,14 @@ def _full_horizon(trial: dict[str, Any]) -> tuple[int, int]:
 
     The LR schedule must see the trial's *full* horizon at every rung —
     otherwise a promoted trial's warmup/decay would differ from the
-    uninterrupted run and rung losses would not be comparable. Replicates
-    ``OptimizationConfig.set_to_dataset``'s ``ceil(len/batch) * max_epochs``.
+    uninterrupted run and rung losses would not be comparable. A
+    trial-specified ``optimization_config.max_training_steps`` is honored
+    as-is; otherwise the horizon replicates
+    ``OptimizationConfig.set_to_dataset``: ``ceil(len/batch) * max_epochs``
+    for padded epochs, or the packed-batch count (same seed/seq-len defaults
+    as ``pretrain.train``) when the trial enables packed batches or context
+    parallelism — the padded count would over-pin the schedule by the packing
+    factor.
     """
     import math
 
@@ -231,13 +237,38 @@ def _full_horizon(trial: dict[str, Any]) -> tuple[int, int]:
 
     oc_defaults = OptimizationConfig()
     max_epochs = int(trial.get("optimization_config.max_epochs", oc_defaults.max_epochs))
+
+    explicit_steps = trial.get("optimization_config.max_training_steps")
+    if explicit_steps is not None:
+        return max_epochs, int(explicit_steps)
+
     batch_size = int(trial.get("optimization_config.batch_size", oc_defaults.batch_size))
 
     dc_kwargs = {
         k.split(".", 1)[1]: v for k, v in trial.items() if k.startswith("data_config.")
     }
     ds = JaxDataset(PytorchDatasetConfig(**dc_kwargs), "train")
-    steps_per_epoch = int(math.ceil(len(ds) / batch_size))
+
+    n_cp = int(trial.get("trainer_config.context_parallel_shards") or 1)
+    use_packed = bool(trial.get("trainer_config.use_packed_batches")) or n_cp > 1
+    if use_packed:
+        # Mirror pretrain.train's packed row-length default: an explicit
+        # trainer_config.packed_seq_len, else the larger of the configured
+        # model context (the trial's value, or the
+        # StructuredTransformerConfig class default pretrain would see) and
+        # the dataset's per-subject cap.
+        from eventstreamgpt_tpu.models.config import StructuredTransformerConfig
+
+        configured_msl = int(
+            trial.get("config.max_seq_len") or StructuredTransformerConfig().max_seq_len
+        )
+        packed_L = int(
+            trial.get("trainer_config.packed_seq_len") or max(configured_msl, ds.max_seq_len)
+        )
+        seed = int(trial.get("seed", 1))
+        steps_per_epoch = ds.packed_batch_count(batch_size, seq_len=packed_L, seed=seed)
+    else:
+        steps_per_epoch = int(math.ceil(len(ds) / batch_size))
     return max_epochs, steps_per_epoch * max_epochs
 
 

@@ -59,7 +59,7 @@ class TestShardedGeneration:
 
     def test_indivisible_batch_rejected(self, model_setup):
         model, params, batch = model_setup
-        with pytest.raises(ValueError, match="must divide"):
+        with pytest.raises(ValueError, match="must be divisible"):
             generate(
                 model,
                 params,
