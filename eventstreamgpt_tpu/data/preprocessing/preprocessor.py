@@ -37,6 +37,23 @@ class Preprocessor(ABC):
         """Fits the pre-processing model over raw observations ``column``."""
         raise NotImplementedError("Subclass must implement abstract method")
 
+    def fit_grouped(self, values, keys):
+        """Fits one params struct per vocabulary key: ``values`` grouped by
+        ``keys`` (aligned pandas Series) → object Series of params dicts
+        indexed by key.
+
+        The default loops `fit` over groups — correct for any plugin. The
+        shipped plugins override it with one grouped aggregation: the ETL
+        fit path is O(rows) vectorized work, not O(keys) Python calls
+        (mirrors the reference's grouped Polars expressions,
+        ``/root/reference/EventStream/data/dataset_polars.py:899-1035``).
+        """
+        import pandas as pd
+
+        return pd.Series(
+            {k: self.fit(g.to_numpy()) for k, g in values.groupby(keys)}, dtype=object
+        )
+
     @classmethod
     @abstractmethod
     def predict(cls, column: np.ndarray, model_params: dict[str, np.ndarray]) -> np.ndarray:

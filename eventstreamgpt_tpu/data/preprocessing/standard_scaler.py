@@ -37,6 +37,26 @@ class StandardScaler(Preprocessor):
             "std_": float(np.std(column, ddof=1)) if len(column) > 1 else float("nan"),
         }
 
+    def fit_grouped(self, values, keys):
+        """All keys fit in one grouped aggregation (pandas ``std`` is the
+        sample std, ddof=1, and is NaN for singleton groups — exactly
+        ``fit``'s convention).
+
+        Examples:
+            >>> import pandas as pd
+            >>> out = StandardScaler().fit_grouped(
+            ...     pd.Series([1., 2., 3., 7.]), pd.Series(list("aaab")))
+            >>> out["a"] == {"mean_": 2.0, "std_": 1.0}
+            True
+            >>> out["b"]["mean_"], str(out["b"]["std_"])
+            (7.0, 'nan')
+        """
+        import pandas as pd
+
+        agg = values.astype(np.float64).groupby(keys).agg(["mean", "std"])
+        agg.columns = ["mean_", "std_"]
+        return pd.Series(agg.to_dict("index"), dtype=object).reindex(agg.index)
+
     @classmethod
     def predict(cls, column: np.ndarray, model_params: dict[str, np.ndarray]) -> np.ndarray:
         return (np.asarray(column, dtype=np.float64) - model_params["mean_"]) / model_params["std_"]

@@ -42,6 +42,29 @@ class StddevCutoffOutlierDetector(Preprocessor):
             "thresh_small_": mean - self.stddev_cutoff * std,
         }
 
+    def fit_grouped(self, values, keys):
+        """All keys' thresholds in one grouped aggregation (sample std,
+        NaN for singleton groups, like ``fit``).
+
+        Examples:
+            >>> import pandas as pd
+            >>> S = StddevCutoffOutlierDetector(stddev_cutoff=1.0)
+            >>> out = S.fit_grouped(pd.Series([1., 3.]), pd.Series(["a", "a"]))
+            >>> out["a"] == {"thresh_large_": 2.0 + 1.4142135623730951,
+            ...              "thresh_small_": 2.0 - 1.4142135623730951}
+            True
+        """
+        import pandas as pd
+
+        agg = values.astype(np.float64).groupby(keys).agg(["mean", "std"])
+        out = pd.DataFrame(
+            {
+                "thresh_large_": agg["mean"] + self.stddev_cutoff * agg["std"],
+                "thresh_small_": agg["mean"] - self.stddev_cutoff * agg["std"],
+            }
+        )
+        return pd.Series(out.to_dict("index"), dtype=object).reindex(out.index)
+
     @classmethod
     def predict(cls, column: np.ndarray, model_params: dict[str, np.ndarray]) -> np.ndarray:
         column = np.asarray(column, dtype=np.float64)

@@ -290,6 +290,31 @@ def _config_signature(config: StructuredTransformerConfig) -> str:
     return json.dumps(config.to_dict(), sort_keys=True, default=str)
 
 
+# Serializing a realistic config (full measurement metadata + vocab maps)
+# costs milliseconds; generate() runs once per eval batch, so the signature
+# is memoized per live model object (weakly — a dead model's id can be
+# recycled, hence the identity re-check).
+_SIG_CACHE: dict[int, tuple[Any, str]] = {}
+
+
+def _model_config_signature(model, config: StructuredTransformerConfig) -> str:
+    import weakref
+
+    key = id(model)
+    hit = _SIG_CACHE.get(key)
+    if hit is not None and hit[0]() is model:
+        return hit[1]
+    sig = _config_signature(config)
+    try:
+        ref = weakref.ref(model)
+    except TypeError:
+        return sig
+    if len(_SIG_CACHE) >= 64:
+        _SIG_CACHE.clear()
+    _SIG_CACHE[key] = (ref, sig)
+    return sig
+
+
 def _cached_steps(cache_key: tuple, build):
     hit = _STEP_CACHE.get(cache_key)
     if hit is not None:
@@ -383,7 +408,7 @@ def _generate_ci(
     cursor = jnp.asarray(input_len, jnp.int32)
 
     steps = _cached_steps(
-        ("ci", _config_signature(config), B, input_len, max_new_events),
+        ("ci", _model_config_signature(model, config), B, input_len, max_new_events),
         lambda: _build_ci_steps(model, config, B, input_len, max_new_events),
     )
     prefix_step = steps["prefix_step"]
@@ -547,7 +572,7 @@ def _generate_na(
     cursor = jnp.asarray(input_len, jnp.int32)
 
     steps = _cached_steps(
-        ("na", _config_signature(config), B, input_len, max_new_events),
+        ("na", _model_config_signature(model, config), B, input_len, max_new_events),
         lambda: _build_na_steps(model, config, B, input_len, max_new_events),
     )
     measurements_to_fill_list = steps["measurements_to_fill_list"]

@@ -388,6 +388,23 @@ class TestNumericSemantics:
         vocab = set(cfg.vocabulary.vocabulary)
         assert {"k__EQ_1", "k__EQ_2", "k__EQ_3"}.issubset(vocab)
 
+    def test_all_categorical_keys_with_outlier_detector(self, tmp_path):
+        """When every key is inferred categorical, no numeric rows reach the
+        outlier/normalizer fits — the (empty) grouped fit must not crash and
+        the value types must survive (regression: the vectorized param
+        alignment indexed columns of an empty params frame)."""
+        values = [1.0, 2.0, 3.0] * 20  # categorical-integer by cardinality
+        ESD = self._fit_dataset(
+            tmp_path,
+            values,
+            min_true_float_frequency=0.1,
+            min_unique_numerical_observations=20,
+            outlier_detector_config={"cls": "stddev_cutoff", "stddev_cutoff": 4.0},
+            normalizer_config={"cls": "standard_scaler"},
+        )
+        md = ESD.measurement_configs["lab"].measurement_metadata
+        assert md.loc["k", "value_type"] == NumericDataModalitySubtype.CATEGORICAL_INTEGER
+
     def test_single_value_keys_dropped(self, tmp_path):
         values = [7.0] * 30
         ESD = self._fit_dataset(tmp_path, values)
