@@ -71,13 +71,21 @@ class GaussianIndexedRegressionLayer(nn.Module):
     @nn.compact
     def __call__(self, X: jnp.ndarray, idx: jnp.ndarray | None = None) -> Normal:
         Z = nn.Dense(self.n_regression_targets * 2, dtype=self.dtype, name="proj")(X)
-        Z = Z.astype(jnp.float32)
-        Z_mean = Z[..., 0::2]
-        Z_std = _elu_plus_one(Z[..., 1::2])
         if idx is None:
-            return Normal(loc=Z_mean, scale=Z_std)
-        mean = jnp.take_along_axis(Z_mean, idx, axis=-1)
-        std = jnp.take_along_axis(Z_std, idx, axis=-1)
+            Z = Z.astype(jnp.float32)
+            return Normal(loc=Z[..., 0::2], scale=_elu_plus_one(Z[..., 1::2]))
+        # Indexed path (training): gather the observed targets' params
+        # straight from the interleaved projection (mean at 2*idx, std at
+        # 2*idx+1) and only then upcast + activate. Elementwise ops commute
+        # with the gather, so the forward is bit-identical to gathering from
+        # the dense mean/std (the backward's gather-gradient scatter now
+        # accumulates in the compute dtype, so duplicate-index events may
+        # round differently in bf16) — and the de-interleave copies, fp32
+        # materialization, and ELU all happen on (B, L, n_observed) instead
+        # of (B, L, 2*vocab): profiling showed the full-size passes (plus
+        # their backward scatters) dominating the head-stack step cost.
+        mean = jnp.take_along_axis(Z, 2 * idx, axis=-1).astype(jnp.float32)
+        std = _elu_plus_one(jnp.take_along_axis(Z, 2 * idx + 1, axis=-1).astype(jnp.float32))
         return Normal(loc=mean, scale=std)
 
 
