@@ -8,6 +8,7 @@ fuse under XLA.
 
 from .tensor_ops import (  # noqa: F401
     embedding_bag,
+    grouped_embedding_bag,
     expand_indexed_regression,
     measurement_index_normalization,
     safe_masked_max,

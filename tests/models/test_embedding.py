@@ -184,6 +184,47 @@ class TestBucketing:
         expected = 0.5 * cat_embed + 0.5 * num_embed
         np.testing.assert_allclose(out[0, 0, 0], expected, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("normalize", [False, True])
+    def test_joint_grouped_matches_broadcast_formulation(self, normalize):
+        """The one-gather grouped JOINT path equals the reference's G-fold
+        broadcast formulation (embed the same tokens per group; a token
+        weighs its value inside the group's numerical mask and 1 elsewhere
+        — data_embedding_layer.py:575-588 + :380-388), including under
+        measurement-index normalization."""
+        from eventstreamgpt_tpu.ops import embedding_bag, measurement_index_normalization
+
+        batch = make_batch()
+        groups = (
+            ((4, MeasIndexGroupOptions.CATEGORICAL_ONLY),),
+            (5, (4, MeasIndexGroupOptions.CATEGORICAL_AND_NUMERICAL)),
+        )
+        layer = DataEmbeddingLayer(
+            n_total_embeddings=12,
+            out_dim=4,
+            static_embedding_mode=StaticEmbeddingMode.DROP,
+            split_by_measurement_indices=groups,
+            do_normalize_by_measurement_index=normalize,
+        )
+        params = init_layer(layer, batch)
+        out = np.asarray(layer.apply(params, batch))
+        assert out.shape == (2, 3, 2, 4)
+
+        # Reference formulation: broadcast every token to every group and run
+        # the ungrouped bag with the group's numerical mask.
+        _, num_mask = layer.bind(params)._split_batch_into_measurement_index_buckets(batch)
+        table = np.asarray(params["params"]["embed_table"])
+        shape = np.asarray(num_mask).shape  # (B, L, G, M)
+        indices = jnp.broadcast_to(batch.dynamic_indices[:, :, None, :], shape)
+        values = jnp.broadcast_to(batch.dynamic_values[:, :, None, :], shape)
+        meas = jnp.broadcast_to(batch.dynamic_measurement_indices[:, :, None, :], shape)
+        vmask = jnp.broadcast_to(batch.dynamic_values_mask[:, :, None, :], shape) & num_mask
+        w = jnp.where(vmask, values, 1.0)
+        if normalize:
+            w = w * measurement_index_normalization(meas)
+        expected = np.asarray(embedding_bag(jnp.asarray(table), indices, w))
+        expected = expected * np.asarray(batch.event_mask)[:, :, None, None]
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
     def test_empty_non_first_group_raises(self):
         batch = make_batch()
         layer = DataEmbeddingLayer(
