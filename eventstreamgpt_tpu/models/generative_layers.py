@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..distributions import Exponential, LogNormalMixture, Normal
+from ..ops.pallas_heads import vocab_gather
 
 
 def _elu_plus_one(x: jnp.ndarray) -> jnp.ndarray:
@@ -78,14 +79,20 @@ class GaussianIndexedRegressionLayer(nn.Module):
         # straight from the interleaved projection (mean at 2*idx, std at
         # 2*idx+1) and only then upcast + activate. Elementwise ops commute
         # with the gather, so the forward is bit-identical to gathering from
-        # the dense mean/std (the backward's gather-gradient scatter now
-        # accumulates in the compute dtype, so duplicate-index events may
-        # round differently in bf16) — and the de-interleave copies, fp32
+        # the dense mean/std — and the de-interleave copies, fp32
         # materialization, and ELU all happen on (B, L, n_observed) instead
         # of (B, L, 2*vocab): profiling showed the full-size passes (plus
         # their backward scatters) dominating the head-stack step cost.
-        mean = jnp.take_along_axis(Z, 2 * idx, axis=-1).astype(jnp.float32)
-        std = _elu_plus_one(jnp.take_along_axis(Z, 2 * idx + 1, axis=-1).astype(jnp.float32))
+        # `vocab_gather` rides a Pallas kernel on TPU backends (factored
+        # one-hot MXU contraction, fp32 duplicate accumulation in the
+        # backward — see ops/pallas_heads.py); elsewhere it is XLA
+        # take_along_axis, whose backward scatter accumulates in the
+        # compute dtype (duplicate-index events may round differently in
+        # bf16).
+        m = idx.shape[-1]
+        both = vocab_gather(Z, jnp.concatenate([2 * idx, 2 * idx + 1], axis=-1))
+        mean = both[..., :m]
+        std = _elu_plus_one(both[..., m:])
         return Normal(loc=mean, scale=std)
 
 
