@@ -338,8 +338,28 @@ class InnerSelfAttention(nn.Module):
             outputs = {"present_key_value": None}
         elif use_pallas:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
+                BlockSizes,
                 SegmentIds,
                 flash_attention,
+            )
+
+            # The kernel's default 128-wide blocks leave the MXU badly
+            # underfed at long sequence lengths: at B=8/H=16/L=1024/d=64 the
+            # measured fwd+bwd cost is 11.5 ms/layer at the defaults vs
+            # 4.6 ms at 512-wide blocks (and the splash causal kernel
+            # measures 9.5 ms — flash+big-blocks wins). Use 512 (or S, if
+            # smaller) whenever it divides the sequence length; otherwise
+            # keep the kernel's defaults.
+            bn = min(512, S)
+            block_sizes = (
+                BlockSizes(
+                    block_q=bn, block_k_major=bn, block_k=bn, block_b=1,
+                    block_q_major_dkv=bn, block_k_major_dkv=bn,
+                    block_k_dkv=bn, block_q_dkv=bn,
+                    block_k_major_dq=bn, block_k_dq=bn, block_q_dq=bn,
+                )
+                if S % bn == 0
+                else BlockSizes.get_default(B, num_heads, S, S, query.shape[-1])
             )
 
             # GPT-Neo lineage: logits are NOT scaled by 1/sqrt(head_dim).
@@ -353,6 +373,7 @@ class InnerSelfAttention(nn.Module):
                 segment_ids=SegmentIds(q=seg, kv=seg),
                 causal=True,
                 sm_scale=1.0,
+                block_sizes=block_sizes,
             ).astype(value.dtype)
             outputs = {"present_key_value": None}
         elif use_splash:
