@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..data.types import EventStreamBatch
 from ..models.config import StructuredEventProcessingMode, StructuredTransformerConfig
 from ..models.transformer import NAPast, init_kv_caches, time_from_deltas
+from ..ops.tensor_ops import take_event
 from .sampling import append_new_event, sample_predictions, update_last_event_data
 from .stopping_criteria import MaxLengthCriteria, StoppingCriteriaList
 
@@ -84,9 +85,11 @@ def _slice_preds_at(preds, idx: Array):
     def take(x):
         if x is None:
             return None
-        sel = jnp.asarray(idx).reshape((1,) * x.ndim)
-        sel = jnp.broadcast_to(sel, x.shape[:1] + (1,) + x.shape[2:])
-        return jnp.take_along_axis(x, sel, axis=1)[:, 0]
+        if x.shape[1] == 1:
+            # Decode-scan views are one event long — a static slice; the
+            # take_along_axis this replaces measured ~1 ms/leaf/event on TPU.
+            return x[:, 0]
+        return take_event(x, idx)
 
     return jax.tree_util.tree_map(take, preds)
 
@@ -100,11 +103,11 @@ def _trim_to_event(batch: EventStreamBatch, idx: Array) -> EventStreamBatch:
     B = batch.event_mask.shape[0]
     t_full = time_from_deltas(batch)
 
-    def take2(x):  # (B, L) -> (B, 1)
-        return jnp.take_along_axis(x, jnp.broadcast_to(idx, (B,))[:, None], axis=1)
+    def take2(x):  # (B, L) -> (B, 1); masked-reduce, not gather (take_event)
+        return take_event(x, idx)[:, None]
 
     def take3(x):  # (B, L, M) -> (B, 1, M)
-        return jnp.take_along_axis(x, jnp.broadcast_to(idx, (B,))[:, None, None], axis=1)
+        return take_event(x, idx)[:, None, :]
 
     return batch.replace(
         event_mask=take2(batch.event_mask),
