@@ -289,8 +289,25 @@ class InnerSelfAttention(nn.Module):
             and S % 128 == 0
         )
         use_pallas = kernel_ok and self.attention_type == "global"
+        # Narrow-window local layers skip the kernels entirely: the chunked
+        # band einsum (ops/band_attention.py) touches only a (C, 2C) logits
+        # plane per window-sized chunk and measured ~35-45% faster fwd+bwd
+        # than the splash kernel's best block shape at production width
+        # (scripts/probe_local_band.py). It is backend-independent (pure
+        # einsums), so it activates under the fused gate on CPU too; splash
+        # remains the local path for wide windows, where its block-skipping
+        # scheduler amortizes.
+        use_band = (
+            fused_ok
+            and cfg.attention_implementation == "pallas_flash"
+            and self.attention_type == "local"
+            and self.window_size is not None
+            and 1 <= self.window_size <= 128
+            and S % self.window_size == 0
+        )
         use_splash = (
             kernel_ok
+            and not use_band
             and self.attention_type == "local"
             and self.window_size is not None
             and self.window_size >= 1
@@ -313,7 +330,7 @@ class InnerSelfAttention(nn.Module):
         # padded keys (finite outputs, discarded by the event-mask zeroing
         # between layers).
         seg = None
-        if ring_ctx is not None or use_pallas or use_splash:
+        if ring_ctx is not None or use_pallas or use_splash or use_band:
             base_seg = (
                 segment_ids if segment_ids is not None else jnp.zeros((B, S), dtype=jnp.int32)
             )
@@ -344,13 +361,18 @@ class InnerSelfAttention(nn.Module):
             )
 
             # The kernel's default 128-wide blocks leave the MXU badly
-            # underfed at long sequence lengths: at B=8/H=16/L=1024/d=64 the
-            # measured fwd+bwd cost is 11.5 ms/layer at the defaults vs
-            # 4.6 ms at 512-wide blocks (and the splash causal kernel
-            # measures 9.5 ms — flash+big-blocks wins). Use 512 (or S, if
-            # smaller) whenever it divides the sequence length; otherwise
-            # keep the kernel's defaults.
-            bn = min(512, S)
+            # underfed at long sequence lengths; the sweet spot depends on
+            # head_dim (scripts/probe_flash_blocks.py, fwd+bwd per global
+            # layer at B=8/L=1024, quiet-window sustained protocol):
+            # d=128 → 1.72 ms at 1024-wide vs 1.90 at 512 / 5.08 at 128 /
+            # 9.2 at defaults; d=64 → 4.0 ms at 512-wide vs 5.8 at 256 /
+            # 11.5 at defaults (and the splash causal kernel measures 9.5 —
+            # flash+big-blocks wins). Pick the largest measured-good width
+            # that divides the sequence length; otherwise keep the kernel's
+            # defaults.
+            head_dim = query.shape[-1]
+            preferred = (1024, 512, 256) if head_dim >= 128 else (512, 256, 128)
+            bn = next((b for b in preferred if b <= S and S % b == 0), None)
             block_sizes = (
                 BlockSizes(
                     block_q=bn, block_k_major=bn, block_k=bn, block_b=1,
@@ -358,8 +380,8 @@ class InnerSelfAttention(nn.Module):
                     block_k_dkv=bn, block_q_dkv=bn,
                     block_k_major_dq=bn, block_k_dq=bn, block_q_dq=bn,
                 )
-                if S % bn == 0
-                else BlockSizes.get_default(B, num_heads, S, S, query.shape[-1])
+                if bn is not None
+                else BlockSizes.get_default(B, num_heads, S, S, head_dim)
             )
 
             # GPT-Neo lineage: logits are NOT scaled by 1/sqrt(head_dim).
@@ -375,6 +397,11 @@ class InnerSelfAttention(nn.Module):
                 sm_scale=1.0,
                 block_sizes=block_sizes,
             ).astype(value.dtype)
+            outputs = {"present_key_value": None}
+        elif use_band:
+            from ..ops.band_attention import band_local_attention
+
+            attn_output = band_local_attention(query, key, value, seg, self.window_size)
             outputs = {"present_key_value": None}
         elif use_splash:
             from jax.experimental.pallas.ops.tpu.splash_attention import (

@@ -1,0 +1,92 @@
+"""Narrow-window local attention as a chunked band einsum.
+
+The reference's "local" attention layers (sliding window, default 32 —
+``/root/reference/EventStream/transformer/transformer.py:109-118``) touch a
+band of at most ``window`` keys per query, so any formulation that sweeps an
+``(L, L)`` plane — blocked or not — is overhead. Device measurements at
+production width (``scripts/probe_local_band.py`` / ``probe_splash_blocks.py``,
+B=8, L=1024, window=32, fwd+bwd per layer, sustained protocol):
+
+* splash kernel, best block shape (its 128x128 default): 1.45 ms
+* this band einsum: measured ~35-45% faster in the same windows
+
+The trick: reshape the sequence into window-sized chunks; a query in chunk
+``n`` attends only keys in chunks ``n-1`` and ``n`` (which cover exactly the
+causal window ``(q - W, q]``), so the logits plane is ``(C, 2C)`` per chunk
+instead of any ``(L, L)`` structure. Everything is a dense einsum: XLA fuses
+the masking/softmax, differentiates it natively, and the formulation runs on
+every backend (the parity test pins it against the full-mask einsum path on
+CPU, exact to bf16 rounding).
+
+Packed-segment convention matches the fused kernels in
+``models/transformer.py``: padding rides as segment id -1, so padded queries
+attend only among padded keys and stay finite; a chunk's "previous" chunk at
+row start is given segment -2 so it can never match.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["band_local_attention"]
+
+
+def band_local_attention(
+    query: jnp.ndarray,
+    key: jnp.ndarray,
+    value: jnp.ndarray,
+    segment_ids: jnp.ndarray,
+    window: int,
+) -> jnp.ndarray:
+    """Exact sliding-window attention: ``k <= q`` and ``k > q - window``.
+
+    Args:
+        query / key / value: ``(B, H, L, D)`` with ``L % window == 0``.
+        segment_ids: ``(B, L)`` int segment ids; queries attend only keys of
+            the same segment (use -1 for padding positions).
+        window: the local window width ``W`` (the chunk size ``C``).
+
+    Returns:
+        ``(B, H, L, D)`` attention outputs (same dtype as ``value``).
+        Logits are NOT scaled by ``1/sqrt(D)`` (GPT-Neo lineage, matching the
+        einsum path); softmax statistics are computed in fp32.
+    """
+    B, H, L, D = query.shape
+    C = window
+    if L % C != 0:
+        raise ValueError(f"sequence length {L} must be divisible by window {window}")
+    nc = L // C
+
+    def chunk(x):  # (B, H, L, D) -> (B, H, nc, C, D)
+        return x.reshape(B, H, nc, C, D)
+
+    def with_prev(x):  # (B, H, nc, C, D) -> (B, H, nc, 2C, D)
+        prev = jnp.pad(x[:, :, :-1], ((0, 0), (0, 0), (1, 0), (0, 0), (0, 0)))
+        return jnp.concatenate([prev, x], axis=3)
+
+    qc = chunk(query)
+    k2 = with_prev(chunk(key))
+    v2 = with_prev(chunk(value))
+
+    # Relative positions: query n*C + c vs key (n-1)*C + j, j in [0, 2C).
+    c_off = jnp.arange(C)
+    j_off = jnp.arange(2 * C)
+    rel = (C + c_off[:, None]) - j_off[None, :]  # (C, 2C) = q_pos - k_pos
+    band = (rel >= 0) & (rel < window)
+
+    seg_c = segment_ids.reshape(B, 1, nc, C)
+    seg_prev = jnp.pad(
+        seg_c[:, :, :-1], ((0, 0), (0, 0), (1, 0), (0, 0)), constant_values=-2
+    )
+    seg2 = jnp.concatenate([seg_prev, seg_c], axis=3)  # (B, 1, nc, 2C)
+    seg_ok = seg_c[..., :, None] == seg2[..., None, :]  # (B, 1, nc, C, 2C)
+    mask = band[None, None, None] & seg_ok
+
+    logits = jnp.einsum(
+        "bhncd,bhnjd->bhncj", qc, k2, preferred_element_type=jnp.float32
+    )
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhncj,bhnjd->bhncd", probs.astype(v2.dtype), v2)
+    return out.reshape(B, H, L, D)

@@ -1,0 +1,120 @@
+"""Microbench: formulations of the embedding-table backward scatter.
+
+The width-shape device profile shows the single largest op in the production
+train step is the backward scatter-add of `embedding_bag`'s table gather
+(196k update rows x hidden into a ~4k x hidden table; 7.2 ms/step at
+hidden 1024, VMEM-write bound — random row read-modify-writes against the
+(8,128)-tiled table). Candidates measured here, all computing the identical
+dTable for the same (indices, weights, dBag):
+
+  scatter   — XLA's native VJP of jnp.take (the incumbent).
+  sort      — argsort tokens by index, gather-reorder the per-token grads,
+              then segment_sum with indices_are_sorted=True (collision-free
+              sequential tile writes; pays a 196k sort + a 400 MB reorder).
+  onehot    — one-hot MXU contraction dTable = onehot(idx)^T @ dTok
+              (dense FLOPs 2·N·V·D; wins only if the MXU beats the
+              scatter's write amplification).
+
+Run on the real chip:  python scripts/probe_embedding_bwd.py
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+from eventstreamgpt_tpu.utils.benchmarking import (  # noqa: E402
+    drain,
+    readback_echo_ms,
+    wait_for_quiet,
+)
+
+B, L, M = 8, 1024, 24
+V = 4057  # bench vocab (n_total_embeddings)
+
+
+def make_inputs(D, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    idx = jax.random.randint(ks[0], (B, L, M), 0, V)
+    w = jax.random.normal(ks[1], (B, L, M), jnp.bfloat16)
+    d_bag = jax.random.normal(ks[2], (B, L, D), jnp.bfloat16)
+    return idx, w, d_bag
+
+
+def d_token(idx, w, d_bag):
+    """Per-token grads (N, D): w broadcast against the bag's incoming grad."""
+    pad = (idx != 0).astype(d_bag.dtype)
+    return ((w * pad)[..., None] * d_bag[..., None, :]).reshape(-1, d_bag.shape[-1])
+
+
+def bwd_scatter(idx, w, d_bag, D):
+    d_tok = d_token(idx, w, d_bag)
+    flat = idx.reshape(-1)
+    return jnp.zeros((V, D), d_bag.dtype).at[flat].add(d_tok)
+
+
+def bwd_sort(idx, w, d_bag, D):
+    d_tok = d_token(idx, w, d_bag)
+    flat = idx.reshape(-1)
+    order = jnp.argsort(flat)
+    s_idx = flat[order]
+    s_tok = d_tok[order]
+    return jax.ops.segment_sum(
+        s_tok, s_idx, num_segments=V, indices_are_sorted=True
+    ).astype(d_bag.dtype)
+
+
+def bwd_onehot(idx, w, d_bag, D):
+    d_tok = d_token(idx, w, d_bag)
+    flat = idx.reshape(-1)
+    oh = (flat[:, None] == jnp.arange(V)).astype(jnp.bfloat16)
+    return jnp.einsum("nv,nd->vd", oh, d_tok).astype(d_bag.dtype)
+
+
+def cost_ms(fn, idx, w, d_bag, D, n_pipeline=30, repeats=2):
+    f = jax.jit(lambda i, ww, g: fn(i, ww, g, D))
+    out = f(idx, w, d_bag)
+    drain(out)
+    best = float("inf")
+    for _ in range(repeats):
+        rtt = readback_echo_ms()
+        g = d_bag
+        t0 = time.perf_counter()
+        for _ in range(n_pipeline):
+            out = f(idx, w, g)
+            g = g + 0.0 * out[:1, :1].sum()  # chain
+        drain(out)
+        window = 1000.0 * (time.perf_counter() - t0) - rtt
+        best = min(best, max(window, 0.0) / n_pipeline)
+    return best
+
+
+def main():
+    for D in (256, 1024):
+        idx, w, d_bag = make_inputs(D)
+        # Parity first (CPU-exact up to bf16 summation order).
+        ref = np.asarray(bwd_scatter(idx, w, d_bag, D), np.float32)
+        alt = np.asarray(bwd_sort(idx, w, d_bag, D), np.float32)
+        err = np.abs(ref - alt).max() / max(np.abs(ref).max(), 1e-6)
+        echo, contended = wait_for_quiet()
+        print(f"== D={D} N={B*L*M} V={V} (echo {echo:.2f} ms, contended={contended}; "
+              f"sort-vs-scatter rel err {err:.2e})", flush=True)
+        for name, fn in [("scatter", bwd_scatter), ("sort", bwd_sort),
+                         ("onehot", bwd_onehot)]:
+            try:
+                ms = cost_ms(fn, idx, w, d_bag, D)
+            except Exception as e:
+                print(f"  {name:>8}: FAILED ({type(e).__name__}: {str(e)[:80]})", flush=True)
+                continue
+            print(f"  {name:>8}: {ms:7.3f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

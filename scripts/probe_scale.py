@@ -52,7 +52,9 @@ def tunnel_probe_ms(n: int = 20) -> float:
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--points", default=None, help="comma list like 256x2,1024x12")
+    ap.add_argument("--head-dim", type=int, default=HEAD_DIM)
     args = ap.parse_args(argv)
+    head_dim = args.head_dim
 
     points = POINTS
     if args.points:
@@ -112,8 +114,8 @@ def main(argv=None):
     for hidden, layers in points:
         config = StructuredTransformerConfig(
             hidden_size=hidden,
-            head_dim=HEAD_DIM,
-            num_attention_heads=hidden // HEAD_DIM,
+            head_dim=head_dim,
+            num_attention_heads=hidden // head_dim,
             num_hidden_layers=layers,
             seq_attention_types=["local", "global"],
             seq_window_size=32,

@@ -1,0 +1,126 @@
+"""Block-size tuning probe for the Pallas splash-attention (local) kernel.
+
+The local layers use a 32-wide sliding window (reference default), yet a
+width-shape device profile showed them costing nearly as much as the global
+flash layers (~1.6 ms/layer fwd+bwd) on the kernel's default 128x128 blocks
+— the band is narrow, so the cost is small-block grid overhead, not FLOPs.
+This sweeps q/kv block shapes (and the fused backward kernel) at the
+production-width shape. Run on the real chip:
+
+    python scripts/probe_splash_blocks.py
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+from eventstreamgpt_tpu.utils.benchmarking import (  # noqa: E402
+    drain,
+    readback_echo_ms,
+    wait_for_quiet,
+)
+
+WINDOW = 32
+
+
+def make_inputs(B, H, L, D, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, H, L, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, H, L, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, H, L, D), jnp.bfloat16)
+    seg = jnp.zeros((B, L), jnp.int32).at[:, L // 2 :].set(1)
+    return q, k, v, seg
+
+
+def layer_cost_ms(q, k, v, seg, block_sizes, n_pipeline=20, repeats=2):
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as splash_kernel,
+    )
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_mask as splash_mask,
+    )
+
+    B, H, L, D = q.shape
+    mask = splash_mask.MultiHeadMask(
+        [splash_mask.LocalMask((L, L), (WINDOW - 1, 0), 0) for _ in range(H)]
+    )
+    kernel = splash_kernel.make_splash_mha(
+        mask, head_shards=1, q_seq_shards=1, block_sizes=block_sizes
+    )
+
+    def fwd(q, k, v):
+        out = jax.vmap(
+            lambda qq, kk, vv, s: kernel(
+                qq, kk, vv, segment_ids=splash_kernel.SegmentIds(q=s, kv=s)
+            )
+        )(q, k, v, seg)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    grad_fn = jax.jit(jax.value_and_grad(fwd, argnums=(0, 1, 2)))
+    loss, grads = grad_fn(q, k, v)
+    drain(loss)
+
+    best = float("inf")
+    for _ in range(repeats):
+        rtt = readback_echo_ms()
+        qq = q
+        t0 = time.perf_counter()
+        for _ in range(n_pipeline):
+            loss, (dq, dk, dv) = grad_fn(qq, k, v)
+            qq = qq + 0.0 * dq
+        drain(loss)
+        window = 1000.0 * (time.perf_counter() - t0) - rtt
+        best = min(best, max(window, 0.0) / n_pipeline)
+    return best
+
+
+def main():
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+    )
+
+    def bs(bq, bkv, fused=False):
+        kw = dict(
+            block_q=bq, block_kv=bkv, block_kv_compute=bkv,
+            block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkv,
+            use_fused_bwd_kernel=fused,
+        )
+        if not fused:
+            kw.update(block_q_dq=bq, block_kv_dq=bkv)
+        return sk.BlockSizes(**kw)
+
+    configs = [
+        ("default(128x128)", None),
+        ("q256_kv128", bs(256, 128)),
+        ("q512_kv128", bs(512, 128)),
+        ("q1024_kv128", bs(1024, 128)),
+        ("q512_kv256", bs(512, 256)),
+        ("q1024_kv256", bs(1024, 256)),
+        ("q512_kv128_fused", bs(512, 128, fused=True)),
+        ("q1024_kv128_fused", bs(1024, 128, fused=True)),
+    ]
+    for shape_name, B, H, L, D in [("h1024_hd128", 8, 8, 1024, 128),
+                                   ("h1024_hd64", 8, 16, 1024, 64)]:
+        q, k, v, seg = make_inputs(B, H, L, D)
+        echo, contended = wait_for_quiet()
+        print(f"== {shape_name} B={B} H={H} L={L} D={D} window={WINDOW} "
+              f"(echo {echo:.2f} ms, contended={contended})", flush=True)
+        for name, blocks in configs:
+            try:
+                ms = layer_cost_ms(q, k, v, seg, blocks)
+            except Exception as e:
+                print(f"  {name:>18}: FAILED ({type(e).__name__}: {str(e)[:80]})",
+                      flush=True)
+                continue
+            print(f"  {name:>18}: {ms:7.3f} ms/layer fwd+bwd", flush=True)
+
+
+if __name__ == "__main__":
+    main()

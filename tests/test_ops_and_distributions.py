@@ -102,6 +102,57 @@ class TestTensorOps:
         expected = [[1 / 3, 1 / 9, 1 / 3, 1 / 9, 1 / 9], [1 / 3, 1 / 6, 1 / 3, 1 / 6, 0.0]]
         np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
 
+    def test_take_event_matches_indexing(self):
+        """take_event(x, i) == x[:, i] on random floats/ints/bools, traced index."""
+        from eventstreamgpt_tpu.ops.tensor_ops import take_event
+
+        x_f = jnp.asarray(RNG.normal(size=(3, 7, 5)).astype(np.float32))
+        x_i = jnp.asarray(RNG.integers(-9, 9, size=(3, 7)).astype(np.int32))
+        x_b = jnp.asarray(RNG.integers(0, 2, size=(3, 7, 2, 4)).astype(bool))
+        for idx in (0, 3, 6):
+            traced = jnp.asarray(idx)
+            for x in (x_f, x_i, x_b):
+                np.testing.assert_array_equal(np.asarray(take_event(x, traced)), np.asarray(x[:, idx]))
+                # Python-int fast path too.
+                np.testing.assert_array_equal(np.asarray(take_event(x, idx)), np.asarray(x[:, idx]))
+
+    def test_take_event_preserves_nonfinite_at_selected_slot(self):
+        from eventstreamgpt_tpu.ops.tensor_ops import take_event
+
+        x = jnp.asarray([[1.0, np.nan, np.inf], [2.0, 5.0, -np.inf]])
+        np.testing.assert_array_equal(np.asarray(take_event(x, jnp.asarray(1))), [np.nan, 5.0])
+        np.testing.assert_array_equal(np.asarray(take_event(x, jnp.asarray(2))), [np.inf, -np.inf])
+        # NaN at an UNSELECTED slot never leaks into the result.
+        np.testing.assert_array_equal(np.asarray(take_event(x, jnp.asarray(0))), [1.0, 2.0])
+
+    def test_gather_last_matches_take_along_axis(self):
+        from eventstreamgpt_tpu.ops.tensor_ops import gather_last
+
+        plane_f = jnp.asarray(RNG.normal(size=(2, 3, 11)).astype(np.float32))
+        plane_b = jnp.asarray(RNG.integers(0, 2, size=(2, 3, 11)).astype(bool))
+        idx = jnp.asarray(RNG.integers(0, 11, size=(2, 3, 4)).astype(np.int32))
+        for plane in (plane_f, plane_b):
+            np.testing.assert_array_equal(
+                np.asarray(gather_last(plane, idx)),
+                np.asarray(jnp.take_along_axis(plane, idx, axis=-1)),
+            )
+        # Repeated indices gather the same slot repeatedly (true gather, not sum).
+        rep = jnp.asarray([[[5, 5, 5, 5]] * 3] * 2)
+        np.testing.assert_array_equal(
+            np.asarray(gather_last(plane_f, rep)),
+            np.asarray(jnp.take_along_axis(plane_f, rep, axis=-1)),
+        )
+
+    def test_gather_last_preserves_nan(self):
+        from eventstreamgpt_tpu.ops.tensor_ops import gather_last
+
+        plane = jnp.asarray([[0.0, np.nan, 2.0]])
+        out = gather_last(plane, jnp.asarray([[1, 2]]))
+        np.testing.assert_array_equal(np.asarray(out), [[np.nan, 2.0]])
+        # ...and a NaN at an unselected slot does not poison selected ones.
+        out2 = gather_last(plane, jnp.asarray([[0, 2]]))
+        np.testing.assert_array_equal(np.asarray(out2), [[0.0, 2.0]])
+
 
 class TestDistributions:
     def test_categorical_log_prob(self):
