@@ -127,6 +127,15 @@ class TestFallback:
             seg = jax.numpy.asarray(seg_np)
             out = band_local_attention(q, k, v, seg, W)
 
+            # Any chunk size >= W that divides L is result-identical: the
+            # chunk is a pure performance knob (fp32 here, so exact).
+            for C in {W, 2 * W, L}:
+                if L % C == 0:
+                    out_c = band_local_attention(q, k, v, seg, W, chunk_size=C)
+                    np.testing.assert_allclose(
+                        np.asarray(out_c), np.asarray(out), rtol=1e-6, atol=1e-6
+                    )
+
             pos = np.arange(L)
             m = (pos[None, :] <= pos[:, None]) & (pos[None, :] > pos[:, None] - W)
             m = m[None, None] & (seg_np[:, None, :, None] == seg_np[:, None, None, :]).transpose(0, 1, 3, 2)
