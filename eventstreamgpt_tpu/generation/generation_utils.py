@@ -163,12 +163,15 @@ def generate(
             a `MaxLengthCriteria` inside it also bounds ``max_new_events``. A
             criterion already satisfied by the prompt returns the prompt
             (expanded by ``num_return_sequences``) unchanged.
-        do_validate_batch: Check the prompt for NaN/inf before generating and
-            raise (reference ``:253-269`` checks every step; here every value
-            *written* during generation is already sanitized at the sampling
-            layer — ``sampling.py`` ``nan_to_num``/clamps — so only the
-            prompt can carry non-finites and one up-front check suffices,
-            avoiding a per-event device sync).
+        do_validate_batch: Check the prompt for NaN/inf and raise (reference
+            ``:253-269`` checks every step; here every value *written* during
+            generation is already sanitized at the sampling layer —
+            ``sampling.py`` ``nan_to_num``/clamps — so only the prompt can
+            carry non-finites and one check suffices). The check's device
+            reduction is dispatched up front but its host readback is
+            deferred until the generation dispatches are in flight, so it
+            costs no serial round trip; a bad prompt still raises before any
+            result is returned.
         mesh: Optional device mesh with a ``data`` axis. The (expanded) batch
             is sharded over it with replicated params, so every jitted
             generation step runs data-parallel across the mesh — the
@@ -215,15 +218,36 @@ def generate(
         batch = jax.tree_util.tree_map(_shard_leaf, batch)
         params = jax.device_put(params, NamedSharding(mesh, P()))
 
-    if do_validate_batch and bool(_batch_nonfinite(batch)):
-        raise ValueError(
-            "Non-finite values (NaN/inf) in the prompt batch; generation would "
-            "propagate them. Clean the inputs or pass do_validate_batch=False."
-        )
+    # Dispatch the validity reduction now, but defer its host readback until
+    # the generation programs are in flight: on an RPC-tunneled backend the
+    # readback costs a full data-plane round trip (~80-100 ms — comparable to
+    # decoding dozens of events), and blocking on it up front serializes that
+    # latency before any useful work. Every value *written* during generation
+    # is sanitized at the sampling layer, so a bad prompt can only produce
+    # garbage outputs that are discarded when `_check_prompt` raises before
+    # any result is returned.
+    bad_prompt = _batch_nonfinite(batch) if do_validate_batch else None
+    if bad_prompt is not None:
+        # Start the device->host copy of the scalar now: the wire latency
+        # (the whole cost on a tunneled backend) overlaps the generation
+        # dispatches below, so the bool() in _check_prompt finds the value
+        # already on the host instead of paying a serial round trip.
+        try:
+            bad_prompt.copy_to_host_async()
+        except AttributeError:  # non-jax array (e.g. test doubles)
+            pass
+
+    def _check_prompt():
+        if bad_prompt is not None and bool(bad_prompt):
+            raise ValueError(
+                "Non-finite values (NaN/inf) in the prompt batch; generation would "
+                "propagate them. Clean the inputs or pass do_validate_batch=False."
+            )
 
     bounds = []
     if stopping_criteria is not None:
         if bool(stopping_criteria(batch, n_events=input_len)):
+            _check_prompt()
             return batch
         if stopping_criteria.max_length is not None:
             bounds.append(stopping_criteria.max_length - input_len)
@@ -252,7 +276,7 @@ def generate(
         if mode == StructuredEventProcessingMode.CONDITIONALLY_INDEPENDENT
         else _generate_na
     )
-    return gen(
+    result = gen(
         model,
         params,
         batch,
@@ -262,6 +286,8 @@ def generate(
         use_cache,
         stopping_criteria=stopping_criteria,
     )
+    _check_prompt()
+    return result
 
 
 def _should_stop(big, cursor, stopping_criteria) -> bool:
