@@ -10,7 +10,8 @@ from .config import (
 )
 from .dataset_base import DatasetBase
 from .dataset_pandas import Dataset, Query
-from .jax_dataset import JaxDataset
+from .device_dataset import DeviceDataset
+from .jax_dataset import BatchPlan, JaxDataset
 from .prefetch import DevicePrefetcher, prefetch_to_device
 from .time_dependent_functor import AgeFunctor, TimeDependentFunctor, TimeOfDayFunctor
 from .types import (
@@ -29,8 +30,10 @@ __all__ = [
     "DataModality",
     "Dataset",
     "DatasetBase",
+    "BatchPlan",
     "DatasetConfig",
     "DatasetSchema",
+    "DeviceDataset",
     "DevicePrefetcher",
     "prefetch_to_device",
     "Query",

@@ -1,0 +1,482 @@
+"""Device-resident dataset: CSR arrays in HBM, collation on device.
+
+Why this exists (round-5 headline fix): the padded-epoch metric of record was
+~7x below the measured device step rate, and a feed-path breakdown
+(``scripts/probe_feed.py``) showed the sink is neither host collation
+(~8 ms/batch) nor compute (~13.5 ms/step) but the per-batch ``device_put``
+of ~2.6 MB through a ~80 MB/s, ~90 ms-RTT tunnel (~30+ ms/batch, serialized
+on the data plane). Caching *host* collation — the obvious fix — would not
+touch that wire cost.
+
+The TPU-native design instead moves the whole dataset to the device once and
+re-derives every batch there:
+
+* `DeviceDataset` uploads the `JaxDataset`'s flattened CSR arrays
+  (values + offsets; tens of MB for tutorial-scale cohorts) to HBM a single
+  time per training run.
+* Each step sends only a `BatchPlan` — subject indices, crop starts, and the
+  fill-row validity mask, ~100 bytes — and a jitted collate kernel rebuilds
+  the static-shape ``(B, L, M)`` batch with pure gathers on the TPU, where
+  gathers at these shapes cost microseconds.
+* The plan stream (`JaxDataset.plan_batches`) consumes the identical rng
+  stream host collation uses, so device- and host-collated epochs are
+  bit-identical (tested) and the ``skip_batches`` mid-epoch-resume contract
+  is unchanged.
+
+The reference's analog is the DataLoader worker pool re-padding per item per
+epoch (``/root/reference/EventStream/data/pytorch_dataset.py:568-683``);
+there is no reference analog of device-side collation — it is only possible
+because the CSR redesign made collation a fixed set of dense gathers.
+
+Light per-subject fields (``subject_id``, ``start_time``, subsequence
+bounds, ``stream_labels``) stay host-computed from the plan: they are O(B)
+bytes, and keeping them on the host preserves bit-exact parity with host
+collation for free.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .config import SeqPaddingSide
+from .jax_dataset import BatchPlan, JaxDataset
+from .types import EventStreamBatch
+
+__all__ = ["DeviceDataset", "padded_collate_kernel", "packed_collate_kernel"]
+
+# CSR arrays shipped to HBM, in kernel argument order.
+_RESIDENT_FIELDS = (
+    "subject_event_offsets",
+    "time_delta",
+    "event_data_offsets",
+    "dynamic_indices",
+    "dynamic_measurement_indices",
+    "dynamic_values",
+    "dynamic_values_observed",
+    "static_offsets",
+    "static_indices",
+    "static_measurement_indices",
+)
+
+
+def padded_collate_kernel(
+    arrays: dict,
+    subject_indices,
+    starts,
+    valid,
+    *,
+    L: int,
+    M: int,
+    S: int,
+    pad_right: bool,
+    do_static: bool,
+) -> dict:
+    """The on-device mirror of ``JaxDataset._collate_with_starts``.
+
+    Pure gathers over HBM-resident CSR arrays into static ``(B, L)`` /
+    ``(B, L, M)`` buffers. Matches host collation bit-for-bit, including the
+    fill-row convention: ``valid`` blanks only the two masks — gathered
+    payloads of fill rows are left in place, exactly as the host path leaves
+    them after its post-collation blanking.
+    """
+    offsets = arrays["subject_event_offsets"]
+    ev_lo = offsets[subject_indices]
+    seq_lens = offsets[subject_indices + 1] - ev_lo
+    kept = jnp.minimum(seq_lens, L)
+
+    pos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    if pad_right:
+        event_ids = ev_lo[:, None] + starts[:, None] + pos
+        event_mask = pos < kept[:, None]
+    else:
+        pad = (L - kept)[:, None]
+        event_ids = ev_lo[:, None] + starts[:, None] + (pos - pad)
+        event_mask = pos >= pad
+    event_ids = jnp.where(event_mask, event_ids, 0)
+
+    out = _gather_event_payload(arrays, event_ids, event_mask, M)
+    out["event_mask"] = event_mask & valid[:, None]
+    out["dynamic_values_mask"] = out["dynamic_values_mask"] & valid[:, None, None]
+
+    if do_static:
+        st_off = arrays["static_offsets"]
+        st_lo = st_off[subject_indices]
+        st_n = st_off[subject_indices + 1] - st_lo
+        spos = jnp.arange(S, dtype=jnp.int32)[None, :]
+        st_ids = st_lo[:, None] + spos
+        st_valid = spos < st_n[:, None]
+        st_ids = jnp.where(st_valid, st_ids, 0)
+        out["static_indices"] = jnp.where(st_valid, arrays["static_indices"][st_ids], 0)
+        out["static_measurement_indices"] = jnp.where(
+            st_valid, arrays["static_measurement_indices"][st_ids], 0
+        )
+    return out
+
+
+def packed_collate_kernel(arrays: dict, event_ids, event_mask, *, M: int) -> dict:
+    """On-device payload gather for packed rows.
+
+    The host still runs the (cheap, sequential) first-fit packing and sends
+    the ``(B, L)`` event-id/segment plan; the ``(B, L, M)`` payload gathers —
+    ~97% of the batch bytes — happen here.
+    """
+    out = _gather_event_payload(arrays, event_ids, event_mask, M)
+    out["event_mask"] = event_mask
+    return out
+
+
+def _gather_event_payload(arrays: dict, event_ids, event_mask, M: int) -> dict:
+    """Shared ``(B, L)`` time + ``(B, L, M)`` data-element gathers."""
+    time_delta = jnp.where(event_mask, arrays["time_delta"][event_ids], 0.0)
+
+    data_off = arrays["event_data_offsets"]
+    data_lo = data_off[event_ids]
+    data_n = data_off[event_ids + 1] - data_lo
+    mpos = jnp.arange(M, dtype=jnp.int32)[None, None, :]
+    data_ids = data_lo[..., None] + mpos
+    data_valid = (mpos < data_n[..., None]) & event_mask[..., None]
+    data_ids = jnp.where(data_valid, data_ids, 0)
+
+    values_mask = data_valid & arrays["dynamic_values_observed"][data_ids]
+    return {
+        "time_delta": time_delta.astype(jnp.float32),
+        "dynamic_indices": jnp.where(data_valid, arrays["dynamic_indices"][data_ids], 0),
+        "dynamic_measurement_indices": jnp.where(
+            data_valid, arrays["dynamic_measurement_indices"][data_ids], 0
+        ),
+        "dynamic_values": jnp.where(values_mask, arrays["dynamic_values"][data_ids], 0.0),
+        "dynamic_values_mask": values_mask,
+    }
+
+
+class DeviceDataset:
+    """HBM-resident view of a `JaxDataset` with on-device collation.
+
+    Args:
+        dataset: the host dataset to mirror. Its CSR index arrays must be
+            int32-narrow (`JaxDataset` shrinks them whenever sizes permit; a
+            >2B-element cohort would not fit HBM anyway).
+        mesh: optional device mesh. Resident arrays are replicated over it;
+            collated batches come out sharded batch-dim-over-``data`` (and,
+            with ``context_parallel``, event-dim-over-``context``) — the
+            layouts ``shard_batch`` / ``shard_batch_cp`` would have produced.
+        context_parallel: emit ring-attention input layout.
+    """
+
+    def __init__(
+        self,
+        dataset: JaxDataset,
+        mesh: Mesh | None = None,
+        context_parallel: bool = False,
+    ):
+        self.dataset = dataset
+        self.mesh = mesh
+        self.context_parallel = context_parallel
+        d = dataset.data
+        for name in ("subject_event_offsets", "event_data_offsets", "dynamic_indices"):
+            if getattr(d, name).dtype == np.int64:
+                raise ValueError(
+                    f"JaxDataset.data.{name} did not narrow to int32 "
+                    "(>2^31 elements); such a cohort cannot be device-resident."
+                )
+
+        host = {name: np.asarray(getattr(d, name)) for name in _RESIDENT_FIELDS}
+        # Empty static arrays still participate in gathers when statics are
+        # off; give them one element so index 0 is always in range.
+        for name in ("static_indices", "static_measurement_indices"):
+            if host[name].size == 0:
+                host[name] = np.zeros(1, host[name].dtype)
+        self.nbytes = sum(a.nbytes for a in host.values())
+        if mesh is not None:
+            replicated = NamedSharding(mesh, P())
+            self.arrays = {k: jax.device_put(v, replicated) for k, v in host.items()}
+        else:
+            self.arrays = {k: jnp.asarray(v) for k, v in host.items()}
+        self._kernel_cache: dict = {}
+
+    # ----------------------------------------------------------- shardings
+    # Fields whose dim 1 is the event (sequence) axis — sharded over the
+    # ``context`` mesh axis in ring-attention layouts (mirrors
+    # ``training.pretrain._CP_SEQ_FIELDS`` for the heavy fields).
+    _SEQ_FIELDS = frozenset(
+        {
+            "event_mask",
+            "time_delta",
+            "dynamic_indices",
+            "dynamic_measurement_indices",
+            "dynamic_values",
+            "dynamic_values_mask",
+            "segment_ids",
+        }
+    )
+
+    def _out_sharding(self, ndim: int, seq_axis: bool):
+        if self.mesh is None:
+            return None
+        if seq_axis and self.context_parallel and "context" in self.mesh.shape:
+            return NamedSharding(self.mesh, P("data", "context", *([None] * (ndim - 2))))
+        return NamedSharding(self.mesh, P("data", *([None] * (ndim - 1))))
+
+    def constrain_fields(self, fields: dict) -> dict:
+        """Applies mesh sharding constraints to collate outputs inside jit.
+
+        The in-jit counterpart of the ``out_shardings`` the standalone
+        kernels use — scanned train programs
+        (``training.make_chunked_train_step``) call this so batches
+        materialize in the same layout ``shard_batch`` / ``shard_batch_cp``
+        would have produced.
+        """
+        if self.mesh is None:
+            return fields
+        return {
+            k: jax.lax.with_sharding_constraint(
+                v, self._out_sharding(v.ndim, k in self._SEQ_FIELDS)
+            )
+            for k, v in fields.items()
+        }
+
+    def padded_kernel(self):
+        """The un-jitted padded collate kernel, bound to this dataset's
+        shapes — the single source of the config→kernel mapping."""
+        ds = self.dataset
+        return partial(
+            padded_collate_kernel,
+            L=ds.max_seq_len,
+            M=ds.max_n_dynamic,
+            S=ds.max_n_static,
+            pad_right=ds.seq_padding_side == SeqPaddingSide.RIGHT,
+            do_static=ds.do_produce_static_data,
+        )
+
+    def packed_kernel(self):
+        """The un-jitted packed collate kernel bound to this dataset."""
+        return partial(packed_collate_kernel, M=self.dataset.max_n_dynamic)
+
+    def _jit_kernel(self, key: tuple, kern) -> "jax.stages.Wrapped":
+        if key not in self._kernel_cache:
+            out_shardings = None
+            if self.mesh is not None:
+                # Shapes don't matter for sharding specs — evaluate on ndim.
+                ndims = {
+                    "event_mask": 2,
+                    "time_delta": 2,
+                    "dynamic_indices": 3,
+                    "dynamic_measurement_indices": 3,
+                    "dynamic_values": 3,
+                    "dynamic_values_mask": 3,
+                }
+                if key[0] == "padded" and self.dataset.do_produce_static_data:
+                    ndims["static_indices"] = 2
+                    ndims["static_measurement_indices"] = 2
+                out_shardings = {
+                    k: self._out_sharding(nd, k in self._SEQ_FIELDS)
+                    for k, nd in ndims.items()
+                }
+            self._kernel_cache[key] = jax.jit(kern, out_shardings=out_shardings)
+        return self._kernel_cache[key]
+
+    def _jit_padded(self, B: int):
+        return self._jit_kernel(("padded", B), self.padded_kernel())
+
+    def _jit_packed(self, B: int, L: int):
+        return self._jit_kernel(("packed", B, L), self.packed_kernel())
+
+    # ----------------------------------------------------------- collation
+    def collate(self, plan: BatchPlan) -> EventStreamBatch:
+        """Collates one `BatchPlan` on device → static-shape batch.
+
+        Heavy ``(B, L[, M])`` fields are device arrays; light per-subject
+        fields ride along as host arrays (transferred with the step's
+        arguments, O(B) bytes).
+        """
+        ds = self.dataset
+        B = len(plan.subject_indices)
+        fields = self._jit_padded(B)(
+            self.arrays, plan.subject_indices, plan.starts, plan.valid_mask
+        )
+
+        if ds.config.do_include_start_time_min:
+            if plan.start_time is None:
+                raise ValueError(
+                    "do_include_start_time_min is set but the plan carries no "
+                    "start_time — regenerate plans from this config."
+                )
+            fields["start_time"] = plan.start_time
+        if ds.config.do_include_subsequence_indices:
+            # int32, matching host _collate_with_starts (bit-identical incl.
+            # dtype; the parity tests assert dtypes too).
+            fields["start_idx"] = plan.starts
+            fields["end_idx"] = plan.starts + plan.kept
+        if ds.config.do_include_subject_id:
+            fields["subject_id"] = np.asarray(
+                [ds.subject_ids[i] for i in plan.subject_indices], dtype=np.int64
+            )
+        if ds.has_task:
+            fields["stream_labels"] = {
+                t: np.asarray(
+                    ds.stream_labels[t][plan.subject_indices],
+                    dtype=np.int64
+                    if ds.task_types[t] == "multi_class_classification"
+                    else np.float32,
+                )
+                for t in ds.tasks
+            }
+        fields["valid_mask"] = plan.valid_mask
+        return EventStreamBatch(**fields)
+
+    def batches(
+        self,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int | None = None,
+        drop_last: bool | None = None,
+        skip_batches: int = 0,
+        with_counts: bool = False,
+    ) -> Iterator:
+        """Device-collated mirror of `JaxDataset.batches` (same rng stream).
+
+        With ``with_counts=True`` yields ``(batch, n_events)`` — the event
+        count comes from the plan, so throughput accounting never syncs the
+        device.
+        """
+        for plan in self.dataset.plan_batches(
+            batch_size,
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+            skip_batches=skip_batches,
+        ):
+            b = self.collate(plan)
+            yield (b, plan.n_events) if with_counts else b
+
+    def packed_batches(
+        self,
+        batch_size: int,
+        seq_len: int | None = None,
+        shuffle: bool = True,
+        seed: int | None = None,
+        with_counts: bool = False,
+    ) -> Iterator:
+        """Device-collated mirror of `JaxDataset.packed_batches`.
+
+        Packing order and row contents are identical to the host path (same
+        ``_pack_rows`` call, same rng); the host ships the ``(B, L)``
+        event-id plan (~KBs) and the device gathers the ``(B, L, M)``
+        payload.
+        """
+        ds = self.dataset
+        L = seq_len or ds.max_seq_len
+        n = len(ds)
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        rows = ds._pack_rows(L, rng, order)
+
+        for lo_idx in range(0, len(rows), batch_size):
+            chunk = rows[lo_idx : lo_idx + batch_size]
+            kernel = self._jit_packed(len(chunk), L)
+            event_ids, seg, mask, n_events = ds.packed_row_plan(chunk, L)
+            fields = kernel(self.arrays, event_ids.astype(np.int32), mask)
+            batch = EventStreamBatch(
+                segment_ids=seg, valid_mask=np.ones(len(chunk), dtype=bool), **fields
+            )
+            yield (batch, n_events) if with_counts else batch
+
+    # ------------------------------------------------------- chunked plans
+    def plan_chunks(
+        self,
+        batch_size: int,
+        chunk_steps: int,
+        shuffle: bool = True,
+        seed: int | None = None,
+        drop_last: bool | None = None,
+        skip_batches: int = 0,
+    ) -> Iterator[tuple[dict, int]]:
+        """Yields ``(plans, n_events)`` with ``chunk_steps`` stacked plans.
+
+        ``plans`` maps plan fields to ``(k, B)`` numpy arrays — the payload a
+        scanned multi-step train program (``training.make_chunked_train_step``)
+        consumes to run ``k`` collate+step iterations in ONE device program,
+        amortizing per-dispatch tunnel overhead ``k``-fold. The final chunk
+        may be shorter (``k < chunk_steps``); callers get one extra
+        compilation for it at most.
+        """
+        buf: list[BatchPlan] = []
+        for plan in self.dataset.plan_batches(
+            batch_size,
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+            skip_batches=skip_batches,
+        ):
+            buf.append(plan)
+            if len(buf) == chunk_steps:
+                yield self._stack_plans(buf)
+                buf = []
+        if buf:
+            yield self._stack_plans(buf)
+
+    @staticmethod
+    def _stack_plans(plans: list[BatchPlan]) -> tuple[dict, int]:
+        return (
+            {
+                "subject_indices": np.stack([p.subject_indices for p in plans]),
+                "starts": np.stack([p.starts for p in plans]),
+                "valid_mask": np.stack([p.valid_mask for p in plans]),
+            },
+            sum(p.n_events for p in plans),
+        )
+
+    def packed_plan_chunks(
+        self,
+        batch_size: int,
+        chunk_steps: int,
+        seq_len: int | None = None,
+        shuffle: bool = True,
+        seed: int | None = None,
+        skip_batches: int = 0,
+        drop_short: bool = True,
+    ) -> Iterator[tuple[dict, int]]:
+        """Packed-row analog of `plan_chunks`: ``(k, B, L)`` event-id plans.
+
+        ``drop_short`` skips the final under-filled packed batch (it would
+        retrigger compilation — the training loop drops it too).
+        """
+        ds = self.dataset
+        L = seq_len or ds.max_seq_len
+        n = len(ds)
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(n) if shuffle else np.arange(n)
+        rows = ds._pack_rows(L, rng, order)
+
+        buf: list[tuple] = []
+        n_ev_buf = 0
+        n_seen = 0
+        for lo_idx in range(0, len(rows), batch_size):
+            chunk = rows[lo_idx : lo_idx + batch_size]
+            if drop_short and len(chunk) < batch_size:
+                continue
+            n_seen += 1
+            if n_seen <= skip_batches:
+                continue
+            event_ids, seg, mask, n_events = self.dataset.packed_row_plan(chunk, L)
+            buf.append((event_ids.astype(np.int32), seg.astype(np.int32), mask))
+            n_ev_buf += n_events
+            if len(buf) == chunk_steps:
+                yield self._stack_packed(buf), n_ev_buf
+                buf, n_ev_buf = [], 0
+        if buf:
+            yield self._stack_packed(buf), n_ev_buf
+
+    @staticmethod
+    def _stack_packed(buf: list[tuple]) -> dict:
+        return {
+            "event_ids": np.stack([e for e, _, _ in buf]),
+            "segment_ids": np.stack([s for _, s, _ in buf]),
+            "event_mask": np.stack([m for _, _, m in buf]),
+        }

@@ -51,6 +51,23 @@ def to_int_index(col: pd.Series) -> tuple[pd.Series, list]:
 
 
 @dataclasses.dataclass
+class BatchPlan:
+    """The host-decided, rng-dependent part of one batch (~100 bytes).
+
+    Produced by `JaxDataset.plan_batches`; consumed by host collation
+    (`JaxDataset.batches`) and on-device collation
+    (`DeviceDataset <device_dataset.DeviceDataset>`) identically.
+    """
+
+    subject_indices: np.ndarray  # (B,) int32
+    starts: np.ndarray  # (B,) int32 — subsequence crop start per subject
+    kept: np.ndarray  # (B,) int32 — events kept (min(seq_len, L))
+    valid_mask: np.ndarray  # (B,) bool — False for cyclic fill rows
+    n_events: int  # real (non-fill, non-pad) events in the batch
+    start_time: np.ndarray | None = None  # (B,) float32, when configured
+
+
+@dataclasses.dataclass
 class _CSRData:
     """Flattened ragged event data for one split.
 
@@ -521,6 +538,40 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         return out
 
     # ------------------------------------------------------------- collation
+    def _draw_starts(
+        self, subject_indices: np.ndarray, rng: np.random.Generator
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Draws subsequence crop starts for the given subjects.
+
+        The single point where collation consumes randomness — shared by
+        `collate_indices`, the resume fast-forward, and the device-resident
+        plan stream (`plan_batches`) so all three advance the rng stream
+        identically and produce bit-identical crops.
+
+        Returns ``(starts, kept)``: the start offset into each subject's
+        event range and the number of events kept (``min(seq_len, L)``).
+
+        RANDOM draws from ``[0, seq_len - L)`` — an *exclusive* high bound,
+        deliberately matching the reference's ``np.random.choice(seq_len -
+        max_seq_len)`` (``pytorch_dataset.py:498``), which never samples the
+        final full window. The packed path (`_pack_rows`), a net-new feature
+        with no reference analog, uses the inclusive bound.
+        """
+        d = self.data
+        idx = np.asarray(subject_indices)
+        L = self.max_seq_len
+        seq_lens = d.subject_event_offsets[idx + 1] - d.subject_event_offsets[idx]
+        starts = np.zeros(len(idx), dtype=np.int32)
+        over = seq_lens > L
+        strategy = self.config.subsequence_sampling_strategy
+        if strategy == SubsequenceSamplingStrategy.RANDOM:
+            starts[over] = rng.integers(0, seq_lens[over] - L)
+        elif strategy == SubsequenceSamplingStrategy.TO_END:
+            starts[over] = seq_lens[over] - L
+        elif strategy != SubsequenceSamplingStrategy.FROM_START:
+            raise ValueError(f"Invalid sampling strategy: {strategy}!")
+        return starts, np.minimum(seq_lens, L)
+
     def collate_indices(
         self, subject_indices: np.ndarray, rng: np.random.Generator | None = None
     ) -> EventStreamBatch:
@@ -530,26 +581,29 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         ``(B, max_seq_len, max_n_dynamic)`` — regardless of batch content, so
         the jitted train step never recompiles.
         """
-        d = self.data
         rng = rng or np.random.default_rng()
+        starts, kept = self._draw_starts(subject_indices, rng)
+        return self._collate_with_starts(subject_indices, starts, kept)
+
+    def _collate_with_starts(
+        self,
+        subject_indices: np.ndarray,
+        starts: np.ndarray,
+        kept: np.ndarray,
+        start_time: np.ndarray | None = None,
+    ) -> EventStreamBatch:
+        """Collation body with the crop starts already drawn (rng-free).
+
+        ``start_time`` short-circuits the per-row prior-delta summation when
+        the caller (`batches` via `plan_batches`) already computed it.
+        """
+        d = self.data
         B = len(subject_indices)
         L = self.max_seq_len
         M = self.max_n_dynamic
         S = self.max_n_static
 
         ev_lo = d.subject_event_offsets[subject_indices]
-        ev_hi = d.subject_event_offsets[np.asarray(subject_indices) + 1]
-        seq_lens = ev_hi - ev_lo
-
-        starts = np.zeros(B, dtype=np.int32)
-        over = seq_lens > L
-        strategy = self.config.subsequence_sampling_strategy
-        if strategy == SubsequenceSamplingStrategy.RANDOM:
-            starts[over] = rng.integers(0, seq_lens[over] - L)
-        elif strategy == SubsequenceSamplingStrategy.TO_END:
-            starts[over] = seq_lens[over] - L
-        # FROM_START leaves zeros.
-        kept = np.minimum(seq_lens, L)
 
         # (B, L) global event ids + validity. int32 end to end: the (B, L, M)
         # index arithmetic below is memory-bound and half-width indices halve
@@ -603,10 +657,12 @@ class JaxDataset(SeedableMixin, TimeableMixin):
             )
 
         if self.config.do_include_start_time_min:
-            prior = np.zeros(B, dtype=np.float64)
-            for b, (lo, s) in enumerate(zip(ev_lo, starts)):
-                prior[b] = d.time_delta[lo : lo + s].sum()
-            batch["start_time"] = (d.start_time_min[subject_indices] + prior).astype(np.float32)
+            if start_time is None:
+                prior = np.zeros(B, dtype=np.float64)
+                for b, (lo, s) in enumerate(zip(ev_lo, starts)):
+                    prior[b] = d.time_delta[lo : lo + s].sum()
+                start_time = (d.start_time_min[subject_indices] + prior).astype(np.float32)
+            batch["start_time"] = start_time
         if self.config.do_include_subsequence_indices:
             batch["start_idx"] = starts
             batch["end_idx"] = starts + kept
@@ -747,6 +803,39 @@ class JaxDataset(SeedableMixin, TimeableMixin):
                 open_rows = open_rows[-MAX_OPEN_ROWS:]
         return rows
 
+    def packed_row_plan(
+        self, rows_chunk: list, L: int
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+        """Materializes packed rows into a ``(B, L)`` event-id/segment plan.
+
+        The single definition of the packed-row layout (incl. the convention
+        that trailing padding shares the last segment id so it never creates
+        a phantom segment boundary) — consumed by host collation
+        (`packed_batches`) and by on-device collation
+        (``DeviceDataset.packed_batches`` / ``packed_plan_chunks``) so the
+        two can never drift.
+
+        Returns ``(event_ids, segment_ids, event_mask, n_events)``.
+        """
+        d = self.data
+        B = len(rows_chunk)
+        event_ids = np.zeros((B, L), dtype=np.int64)
+        seg = np.zeros((B, L), dtype=np.int64)
+        mask = np.zeros((B, L), dtype=bool)
+        n_events = 0
+        for b, placements in enumerate(rows_chunk):
+            pos = 0
+            for s_idx, (subj, start, n_ev) in enumerate(placements):
+                lo = d.subject_event_offsets[subj] + start
+                event_ids[b, pos : pos + n_ev] = np.arange(lo, lo + n_ev)
+                seg[b, pos : pos + n_ev] = s_idx
+                mask[b, pos : pos + n_ev] = True
+                pos += n_ev
+            if placements and pos < L:
+                seg[b, pos:] = seg[b, pos - 1]
+            n_events += pos
+        return event_ids, seg, mask, n_events
+
     def packed_batch_count(
         self,
         batch_size: int,
@@ -797,30 +886,10 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         order = rng.permutation(n) if shuffle else np.arange(n)
         rows = self._pack_rows(L, rng, order)
 
-        def materialize(row_placements) -> dict:
-            event_ids = np.zeros(L, dtype=np.int64)
-            seg = np.zeros(L, dtype=np.int64)
-            mask = np.zeros(L, dtype=bool)
-            pos = 0
-            for s_idx, (subj, start, n_ev) in enumerate(row_placements):
-                lo = d.subject_event_offsets[subj] + start
-                event_ids[pos : pos + n_ev] = np.arange(lo, lo + n_ev)
-                seg[pos : pos + n_ev] = s_idx
-                mask[pos : pos + n_ev] = True
-                pos += n_ev
-            # Padding shares the last segment id so it never creates a
-            # phantom segment boundary.
-            if row_placements and pos < L:
-                seg[pos:] = seg[pos - 1]
-            return {"event_ids": event_ids, "segment_ids": seg, "event_mask": mask}
-
         for lo_idx in range(0, len(rows), batch_size):
             chunk = rows[lo_idx : lo_idx + batch_size]
             B = len(chunk)
-            parts = [materialize(r) for r in chunk]
-            event_ids = np.stack([p["event_ids"] for p in parts])
-            event_mask = np.stack([p["event_mask"] for p in parts])
-            segment_ids = np.stack([p["segment_ids"] for p in parts])
+            event_ids, segment_ids, event_mask, _ = self.packed_row_plan(chunk, L)
 
             time_delta = np.where(event_mask, d.time_delta[event_ids], 0.0).astype(np.float32)
 
@@ -847,16 +916,6 @@ class JaxDataset(SeedableMixin, TimeableMixin):
                 valid_mask=np.ones(B, dtype=bool),
             )
 
-    def _consume_collation_rng(self, subject_indices: np.ndarray, rng: np.random.Generator):
-        """Advances ``rng`` exactly as `collate_indices` would, without
-        collating — the fast-forward path for mid-epoch resume."""
-        if self.config.subsequence_sampling_strategy == SubsequenceSamplingStrategy.RANDOM:
-            d = self.data
-            idx = np.asarray(subject_indices)
-            seq_lens = d.subject_event_offsets[idx + 1] - d.subject_event_offsets[idx]
-            over = seq_lens > self.max_seq_len
-            rng.integers(0, seq_lens[over] - self.max_seq_len)
-
     def batches(
         self,
         batch_size: int,
@@ -881,6 +940,45 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         advanced identically, so batch N+1 onward is bitwise-identical to an
         uninterrupted epoch.
         """
+        for plan in self.plan_batches(
+            batch_size, shuffle=shuffle, seed=seed, drop_last=drop_last, skip_batches=skip_batches
+        ):
+            b = self._collate_with_starts(
+                plan.subject_indices, plan.starts, plan.kept, start_time=plan.start_time
+            )
+            n_real = int(plan.valid_mask.sum())
+            if n_real < batch_size:
+                event_mask = np.asarray(b.event_mask).copy()
+                event_mask[n_real:] = False
+                values_mask = np.asarray(b.dynamic_values_mask).copy()
+                values_mask[n_real:] = False
+                b = b.replace(
+                    event_mask=event_mask, dynamic_values_mask=values_mask,
+                    valid_mask=plan.valid_mask,
+                )
+            else:
+                b = b.replace(valid_mask=plan.valid_mask)
+            yield b
+
+    def plan_batches(
+        self,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int | None = None,
+        drop_last: bool | None = None,
+        skip_batches: int = 0,
+    ):
+        """Yields `BatchPlan`s — the ~100-byte rng-dependent part of a batch.
+
+        A plan is everything `batches` decides on the host (subject order,
+        subsequence crop starts, fill-row validity) with none of the array
+        materialization. `batches` collates plans on the host;
+        `DeviceDataset` (``device_dataset.py``) collates them **on device**
+        from HBM-resident arrays, so a training step's host→device traffic is
+        the plan instead of the ~MB batch. Both consume the identical rng
+        stream via `_draw_starts`, so device- and host-collated epochs are
+        bit-identical and ``skip_batches`` resume semantics are shared.
+        """
         n = len(self)
         if drop_last is None:
             drop_last = shuffle
@@ -895,19 +993,22 @@ class JaxDataset(SeedableMixin, TimeableMixin):
                 # batch_size exceeds the dataset size.
                 fill = np.resize(order, batch_size - n_real)
                 idx = np.concatenate([idx, fill])
+            starts, kept = self._draw_starts(idx, rng)
             if i < skip_batches:
-                self._consume_collation_rng(idx, rng)
                 continue
-            b = self.collate_indices(idx, rng=rng)
-            valid = np.arange(batch_size) < n_real
-            if n_real < batch_size:
-                event_mask = np.asarray(b.event_mask).copy()
-                event_mask[n_real:] = False
-                values_mask = np.asarray(b.dynamic_values_mask).copy()
-                values_mask[n_real:] = False
-                b = b.replace(
-                    event_mask=event_mask, dynamic_values_mask=values_mask, valid_mask=valid
-                )
-            else:
-                b = b.replace(valid_mask=valid)
-            yield b
+            start_time = None
+            if self.config.do_include_start_time_min:
+                d = self.data
+                ev_lo = d.subject_event_offsets[idx]
+                prior = np.zeros(batch_size, dtype=np.float64)
+                for b, (elo, s) in enumerate(zip(ev_lo, starts)):
+                    prior[b] = d.time_delta[elo : elo + s].sum()
+                start_time = (d.start_time_min[idx] + prior).astype(np.float32)
+            yield BatchPlan(
+                subject_indices=np.asarray(idx, dtype=np.int32),
+                starts=starts.astype(np.int32),
+                kept=kept.astype(np.int32),
+                valid_mask=np.arange(batch_size) < n_real,
+                n_events=int(kept[:n_real].sum()),
+                start_time=start_time,
+            )

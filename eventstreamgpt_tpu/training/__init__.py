@@ -20,6 +20,7 @@ from .pretrain import (
     build_model,
     data_parallel_mesh,
     evaluate,
+    make_chunked_train_step,
     make_eval_step,
     make_train_step,
     parallel_mesh,
@@ -42,6 +43,7 @@ __all__ = [
     "data_parallel_mesh",
     "evaluate",
     "load_pretrained",
+    "make_chunked_train_step",
     "make_eval_step",
     "make_mesh",
     "make_param_shardings",

@@ -213,12 +213,13 @@ def replicate(tree: Any, mesh: Mesh) -> Any:
 
 
 # ----------------------------------------------------------------- train step
-def make_train_step(model, tx) -> Callable:
-    """A jitted ``(state, batch, rng) -> (state, loss)`` step.
+def _train_step_body(model, tx) -> Callable:
+    """The un-jitted ``(state, batch, rng) -> (state, loss)`` step body.
 
-    Gradients reduce across the ``data`` axis automatically (XLA inserts the
-    psum for replicated-param/sharded-batch layouts). The state is donated so
-    parameters update in place on device.
+    Shared verbatim by the per-batch step (`make_train_step`) and the
+    scanned multi-step program (`make_chunked_train_step`), so both paths
+    have identical numerics: same per-step dropout rng (``fold_in`` on the
+    step counter), same gradient, same optimizer update.
     """
 
     def train_step(state: TrainState, batch: EventStreamBatch, rng: jax.Array):
@@ -236,7 +237,66 @@ def make_train_step(model, tx) -> Callable:
             loss,
         )
 
-    return jax.jit(train_step, donate_argnums=(0,))
+    return train_step
+
+
+def make_train_step(model, tx) -> Callable:
+    """A jitted ``(state, batch, rng) -> (state, loss)`` step.
+
+    Gradients reduce across the ``data`` axis automatically (XLA inserts the
+    psum for replicated-param/sharded-batch layouts). The state is donated so
+    parameters update in place on device.
+    """
+    return jax.jit(_train_step_body(model, tx), donate_argnums=(0,))
+
+
+def make_chunked_train_step(model, tx, device_data, packed: bool = False) -> Callable:
+    """A jitted ``(state, arrays, plans, rng) -> (state, losses)`` program
+    that runs ``k`` collate+train steps in ONE dispatch.
+
+    The round-5 feed-path redesign (``data/device_dataset.py``): with the
+    dataset HBM-resident, a ``lax.scan`` over ``k`` stacked `BatchPlan`s
+    collates each batch on device and steps the optimizer, so per-step wire
+    traffic is ~100 bytes and per-dispatch tunnel overhead (~10-20 ms on the
+    bench tunnel) is amortized ``k``-fold. Numerics are identical to ``k``
+    calls of `make_train_step` on the same plan stream (shared step body,
+    same fold-in rng; tested in ``tests/training/test_resident_training.py``).
+
+    ``plans`` comes from `DeviceDataset.plan_chunks` (padded rows) or
+    `DeviceDataset.packed_plan_chunks` (``packed=True``); ``arrays`` is
+    ``device_data.arrays``. Pretraining ignores per-subject light fields
+    (labels, subject ids), which is why the scanned batch carries none.
+    """
+    body = _train_step_body(model, tx)
+
+    if packed:
+        kern = device_data.packed_kernel()
+
+        def collate(arrays, plan):
+            fields = kern(arrays, plan["event_ids"], plan["event_mask"])
+            fields["segment_ids"] = plan["segment_ids"]
+            fields = device_data.constrain_fields(fields)
+            B = plan["event_ids"].shape[0]
+            return EventStreamBatch(valid_mask=jnp.ones(B, bool), **fields)
+
+    else:
+        kern = device_data.padded_kernel()
+
+        def collate(arrays, plan):
+            fields = kern(
+                arrays, plan["subject_indices"], plan["starts"], plan["valid_mask"]
+            )
+            fields = device_data.constrain_fields(fields)
+            return EventStreamBatch(valid_mask=plan["valid_mask"], **fields)
+
+    def chunk_step(state: TrainState, arrays: dict, plans: dict, rng: jax.Array):
+        def scan_body(st, plan):
+            st, loss = body(st, collate(arrays, plan), rng)
+            return st, loss
+
+        return jax.lax.scan(scan_body, state, plans)
+
+    return jax.jit(chunk_step, donate_argnums=(0,))
 
 
 def make_eval_step(model) -> Callable:
