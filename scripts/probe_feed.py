@@ -200,6 +200,30 @@ def main():
             flush=True,
         )
 
+    # F. chunked-scan epochs: k collate+step iterations per dispatch.
+    from eventstreamgpt_tpu.training import make_chunked_train_step
+
+    for chunk in (4, 8, 16):
+        chunk_step = make_chunked_train_step(model, tx, dd)
+        # compile outside the timing
+        plans0, _ = next(iter(dd.plan_chunks(BATCH, chunk, shuffle=True, seed=0)))
+        state, _ = chunk_step(state, dd.arrays, plans0, rng)
+        drain(_)
+        for rep in range(2):
+            t0 = time.perf_counter()
+            ev = 0
+            nb = 0
+            for plans, n in dd.plan_chunks(BATCH, chunk, shuffle=True, seed=30 + rep):
+                ev += n
+                state, losses = chunk_step(state, dd.arrays, plans, rng)
+                nb += plans["starts"].shape[0]
+            drain(losses)
+            dt = time.perf_counter() - t0
+            print(
+                f"F chunk={chunk} rep{rep}: {1000*dt/nb:.2f} ms/step, {ev/dt:.0f} ev/s",
+                flush=True,
+            )
+
 
 if __name__ == "__main__":
     main()

@@ -141,8 +141,9 @@ class TestResidency:
         ds = make_ds(sample_dir)
         dd = DeviceDataset(ds)
         assert dd.nbytes > 0
-        # Resident bytes ≈ CSR size, far below one collated epoch's traffic.
-        assert dd.nbytes < 10 * 1024 * 1024
+        # Resident bytes ≈ dense-table size (CSR × M/avg_fill) — bounded by
+        # dataset scale, not epoch count × batch traffic.
+        assert dd.nbytes < 64 * 1024 * 1024
 
     def test_mesh_sharded_outputs(self, sample_dir):
         import jax
