@@ -223,6 +223,19 @@ class DeviceDataset:
             self.arrays = {k: jnp.asarray(v) for k, v in host.items()}
         self._kernel_cache: dict = {}
 
+    @staticmethod
+    def estimate_nbytes(dataset: JaxDataset) -> int:
+        """Predicted HBM footprint of residency, without building anything.
+
+        Lets callers (``training.train`` in ``device_resident_data='auto'``
+        mode) gate residency on an HBM budget before paying the host-side
+        dense-table build.
+        """
+        n_rows = len(dataset.data.time_delta) + 2 * dataset.max_seq_len
+        per_row = 4 + dataset.max_n_dynamic * (4 + 4 + 4 + 1)
+        static = 2 * 4 * dataset.max_n_static * max(dataset.data.n_subjects, 1)
+        return n_rows * per_row + static + dataset.data.subject_event_offsets.nbytes
+
     def _build_dense_tables(self) -> dict:
         """CSR → dense per-event tables (see `_RESIDENT_FIELDS` for why)."""
         ds = self.dataset

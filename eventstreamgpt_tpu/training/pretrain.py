@@ -41,6 +41,7 @@ from flax import serialization, struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..data.config import PytorchDatasetConfig
+from ..data.device_dataset import DeviceDataset
 from ..data.jax_dataset import JaxDataset
 from ..data.prefetch import prefetch_to_device
 from ..data.types import EventStreamBatch
@@ -318,6 +319,7 @@ def evaluate(
     mesh: Mesh | None = None,
     key: jax.Array | None = None,
     place_batch: Callable[[EventStreamBatch, Mesh], EventStreamBatch] | None = None,
+    device_data: "DeviceDataset | None" = None,
 ) -> dict[str, float]:
     """Runs one full-split eval pass, returning ``{split}_...`` metrics.
 
@@ -326,7 +328,9 @@ def evaluate(
     double-counted (VERDICT weak #5). ``place_batch`` overrides the default
     data-sharded placement — context-parallel callers pass ``shard_batch_cp``
     so the event axis lands on the ``context`` mesh axis up front instead of
-    being resharded at every ring-attention boundary.
+    being resharded at every ring-attention boundary. ``device_data`` (a
+    `DeviceDataset` over the same split) switches to device-resident
+    collation — identical batches, no per-batch wire transfer.
     """
     metrics = GenerativeMetrics(config, metrics_config, split=split)
     if key is None:
@@ -334,6 +338,19 @@ def evaluate(
     # seed=0 pins the (otherwise random) subsequence crops so every eval pass
     # scores identical data — epoch-to-epoch tuning losses must be comparable
     # for early stopping, and the final validation must match the last epoch.
+    if device_data is not None:
+        # Device-resident eval: batches collate on device from ~100-byte
+        # plans (bit-identical to host collation), so no transfer thread is
+        # needed; collate and eval dispatches pipeline asynchronously.
+        # valid_mask is a host array on device batches — reading it costs no
+        # device sync.
+        for batch in device_data.batches(
+            batch_size, shuffle=False, drop_last=False, seed=0
+        ):
+            out = eval_step(params, batch)
+            key, sub = jax.random.split(key)
+            metrics.update(out, key=sub, n_valid=int(np.asarray(batch.valid_mask).sum()))
+        return metrics.compute()
     placer = place_batch if place_batch is not None else shard_batch
     place = (lambda b: placer(b, mesh)) if mesh is not None else (lambda b: b)
     batch_iter = prefetch_to_device(
@@ -601,6 +618,52 @@ def train(
     train_step = make_train_step(model, tx)
     eval_step = make_eval_step(model)
 
+    # Device-resident data (round-5 feed-path redesign; data/device_dataset.py):
+    # keep the dataset in HBM and run k on-device-collate + train steps per
+    # dispatch. 'auto' enables it for single-process runs whose dense tables
+    # fit a conservative HBM budget; numerics are bit-identical to the host
+    # path (tested), so this is purely a throughput decision.
+    resident_mode = tc.get("device_resident_data", "auto")
+    resident_budget = int(tc.get("device_resident_max_bytes") or 2 * 1024**3)
+    device_train = device_tuning = None
+    if resident_mode is True or (
+        resident_mode == "auto"
+        and jax.process_count() == 1
+        and DeviceDataset.estimate_nbytes(train_pyd) <= resident_budget
+    ):
+        try:
+            device_train = DeviceDataset(train_pyd, mesh=mesh, context_parallel=n_cp > 1)
+            device_tuning = DeviceDataset(tuning_pyd, mesh=mesh, context_parallel=n_cp > 1)
+        except ValueError:
+            if resident_mode is True:
+                raise
+            device_train = device_tuning = None
+    chunk_steps = tc.get("steps_per_execution") or "auto"
+    if chunk_steps == "auto":
+        # Align with the logging cadence so windowed records keep their
+        # meaning; 16 steps/dispatch already amortizes dispatch overhead to
+        # a few percent.
+        chunk_steps = max(min(log_every, ckpt_every, 16), 1)
+    chunk_steps = int(chunk_steps)
+    chunked_step = (
+        make_chunked_train_step(model, tx, device_train, packed=use_packed)
+        if device_train is not None
+        else None
+    )
+
+    def train_plan_chunks(epoch: int, skip: int):
+        if use_packed:
+            return device_train.packed_plan_chunks(
+                oc.batch_size,
+                chunk_steps,
+                seq_len=packed_L,
+                seed=cfg.seed + epoch,
+                skip_batches=skip,
+            )
+        return device_train.plan_chunks(
+            oc.batch_size, chunk_steps, shuffle=True, seed=cfg.seed + epoch, skip_batches=skip
+        )
+
     log_fp = save_dir / "train_log.jsonl" if is_main else None
 
     def log_record(rec: dict) -> None:
@@ -637,54 +700,101 @@ def train(
             window_t0, window_events, window_n = time.perf_counter(), 0, 0
             window_losses: list = []
             epoch_skip = skip_batches if epoch == start_epoch else 0
-            # Asynchronous input pipeline: collation + device_put run in a
-            # background thread with a depth-2 device buffer, so the host path
-            # overlaps the previous step's compute (VERDICT r02 #2). Event counts
-            # are computed host-side in the worker — reading them here would
-            # otherwise force a device sync every step.
-            batch_iter = prefetch_to_device(
-                train_batches(epoch, epoch_skip),
-                lambda b: place_batch(b, mesh),
-                host_stats_fn=lambda b: int(b.event_mask.sum()),
-            )
-            try:
-                for step_in_epoch, (batch, n_events) in enumerate(batch_iter, start=epoch_skip):
-                    if profile_dir and not profiling and 10 <= global_step < 20:
+
+            def handle_window(step_in_epoch: int, stepped: int):
+                """Shared per-step(s) bookkeeping: logs, checkpoints, stop.
+
+                ``stepped`` is how many optimizer-loop steps the last dispatch
+                advanced (1 for the per-batch path, k for a scanned chunk) —
+                cadences fire when the counter crosses a multiple.
+                """
+                nonlocal window_t0, window_events, window_n, window_losses, stop
+                if global_step % log_every < stepped:
+                    dt = time.perf_counter() - window_t0
+                    rec = {
+                        "split": str(Split.TRAIN),
+                        "epoch": epoch,
+                        "step": global_step,
+                        "train_loss": float(jnp.mean(jnp.concatenate(
+                            [jnp.atleast_1d(l) for l in window_losses]
+                        ))),
+                        "lr": float(lr_schedule(global_step // accum)),
+                        "events_per_sec": window_events / dt if dt > 0 else None,
+                        "step_time_ms": 1000.0 * dt / max(window_n, 1),
+                    }
+                    log_record(rec)
+                    window_t0, window_events, window_n = time.perf_counter(), 0, 0
+                    window_losses = []
+                if global_step % ckpt_every < stepped:
+                    ckpt_mgr.save(
+                        global_step,
+                        serialization.to_state_dict(jax.device_get(state)),
+                        metadata={
+                            "epoch": epoch,
+                            "epoch_complete": False,
+                            "step_in_epoch": step_in_epoch,
+                        },
+                    )
+                if (
+                    oc.max_training_steps is not None
+                    and global_step // accum >= oc.max_training_steps
+                ):
+                    stop = True
+
+            if chunked_step is not None:
+                # Device-resident scanned training: k collate+step iterations
+                # per dispatch, ~100-byte plans on the wire (the production
+                # fast path; bit-identical numerics to the branch below).
+                # Window log records buffer their losses as device arrays and
+                # flush at epoch end — a float() here would block on a
+                # data-plane round trip every window and stall the dispatch
+                # pipeline.
+                step_in_epoch = epoch_skip
+                pending_logs: list[dict] = []
+                for plans, n_events in train_plan_chunks(epoch, epoch_skip):
+                    k = int(next(iter(plans.values())).shape[0])
+                    if oc.max_training_steps is not None:
+                        remaining = oc.max_training_steps * accum - global_step
+                        if remaining < k:
+                            plans = {key_: v[:remaining] for key_, v in plans.items()}
+                            k = remaining
+                    if k <= 0:
+                        break
+                    if profile_dir and not profiling and 10 <= global_step + k:
                         jax.profiler.start_trace(str(profile_dir))
                         profiling = True
-                    state, loss = train_step(state, batch, rng)
-                    global_step += 1
+                    state, losses = chunked_step(state, device_train.arrays, plans, rng)
+                    global_step += k
+                    step_in_epoch += k
                     window_events += n_events
-                    # Keep the loss on device: converting every step would sync the
-                    # host with the device and serialize collation with compute.
-                    window_losses.append(loss)
-                    window_n += 1
+                    window_losses.append(losses)
+                    window_n += k
                     if profiling and global_step >= 20:
                         jax.profiler.stop_trace()
                         profiling = False
-
-                    if global_step % log_every == 0:
+                    if global_step % log_every < k:
                         dt = time.perf_counter() - window_t0
-                        rec = {
-                            "split": str(Split.TRAIN),
-                            "epoch": epoch,
-                            "step": global_step,
-                            "train_loss": float(jnp.mean(jnp.stack(window_losses))),
-                            "lr": float(lr_schedule(global_step // accum)),
-                            "events_per_sec": window_events / dt if dt > 0 else None,
-                            "step_time_ms": 1000.0 * dt / max(window_n, 1),
-                        }
-                        log_record(rec)
+                        pending_logs.append(
+                            {
+                                "split": str(Split.TRAIN),
+                                "epoch": epoch,
+                                "step": global_step,
+                                "_losses": jnp.concatenate(window_losses),
+                                "lr": float(lr_schedule(global_step // accum)),
+                                "events_per_sec": window_events / dt if dt > 0 else None,
+                                "step_time_ms": 1000.0 * dt / max(window_n, 1),
+                            }
+                        )
                         window_t0, window_events, window_n = time.perf_counter(), 0, 0
                         window_losses = []
-                    if global_step % ckpt_every == 0:
+                    if global_step % ckpt_every < k:
                         ckpt_mgr.save(
                             global_step,
                             serialization.to_state_dict(jax.device_get(state)),
                             metadata={
                                 "epoch": epoch,
                                 "epoch_complete": False,
-                                "step_in_epoch": step_in_epoch + 1,
+                                "step_in_epoch": step_in_epoch,
                             },
                         )
                     if (
@@ -693,8 +803,44 @@ def train(
                     ):
                         stop = True
                         break
-            finally:
-                batch_iter.close()
+                for rec in pending_logs:
+                    rec["train_loss"] = float(jnp.mean(rec.pop("_losses")))
+                    log_record(rec)
+            else:
+                # Asynchronous host input pipeline: collation + device_put run
+                # in a background thread with a depth-2 device buffer, so the
+                # host path overlaps the previous step's compute (VERDICT r02
+                # #2). Event counts are computed host-side in the worker —
+                # reading them here would otherwise force a device sync every
+                # step.
+                batch_iter = prefetch_to_device(
+                    train_batches(epoch, epoch_skip),
+                    lambda b: place_batch(b, mesh),
+                    host_stats_fn=lambda b: int(b.event_mask.sum()),
+                )
+                try:
+                    for step_in_epoch, (batch, n_events) in enumerate(
+                        batch_iter, start=epoch_skip
+                    ):
+                        if profile_dir and not profiling and 10 <= global_step < 20:
+                            jax.profiler.start_trace(str(profile_dir))
+                            profiling = True
+                        state, loss = train_step(state, batch, rng)
+                        global_step += 1
+                        window_events += n_events
+                        # Keep the loss on device: converting every step would
+                        # sync the host with the device and serialize collation
+                        # with compute.
+                        window_losses.append(loss)
+                        window_n += 1
+                        if profiling and global_step >= 20:
+                            jax.profiler.stop_trace()
+                            profiling = False
+                        handle_window(step_in_epoch + 1, 1)
+                        if stop:
+                            break
+                finally:
+                    batch_iter.close()
             if profiling:
                 jax.profiler.stop_trace()
                 profiling = False
@@ -712,6 +858,7 @@ def train(
                 mesh=mesh,
                 key=eval_key,
                 place_batch=place_batch,
+                device_data=device_tuning,
             )
             tuning_loss = tuning_metrics.get("tuning_loss", float("nan"))
             log_record(
@@ -759,6 +906,11 @@ def train(
         return None, None, None
 
     held_out_pyd = JaxDataset(cfg.data_config, split="held_out")
+    device_held_out = (
+        DeviceDataset(held_out_pyd, mesh=mesh, context_parallel=n_cp > 1)
+        if device_train is not None
+        else None
+    )
     rng, k1, k2 = jax.random.split(rng, 3)
     final_tuning = evaluate(
         eval_step,
@@ -771,6 +923,7 @@ def train(
         mesh=mesh,
         key=k1,
         place_batch=place_batch,
+        device_data=device_tuning,
     )
     final_held_out = evaluate(
         eval_step,
@@ -783,6 +936,7 @@ def train(
         mesh=mesh,
         key=k2,
         place_batch=place_batch,
+        device_data=device_held_out,
     )
 
     if is_main:
