@@ -1,10 +1,13 @@
 """Longitudinal, MCF-based evaluation over measurement predicates.
 
 Rebuild of ``/root/reference/EventStream/evaluation/MCF_evaluation.py`` on
-numpy + pandas (the reference uses numpy + polars; the numpy math is
-identical, the frame ops are re-expressed). Model-free: compares generated
-trajectories to true continuations via empirical CRPS and mean-cumulative-
-function estimation over boolean measurement predicates.
+numpy + pandas (the reference uses numpy + polars; the frame ops are
+re-expressed, the numeric routines re-derived — `crps` uses the
+order-statistic gap decomposition directly rather than the reference's
+flip/cumsum formulation inherited from pyro's ``crps_empirical``; doctest
+fixtures are kept as behavior-parity anchors). Model-free: compares
+generated trajectories to true continuations via empirical CRPS and
+mean-cumulative-function estimation over boolean measurement predicates.
 """
 
 from __future__ import annotations
@@ -64,20 +67,22 @@ def crps(samples: np.ndarray, true: np.ndarray) -> np.ndarray:
     if samples.shape[0] == 1:
         return np.abs(samples[0] - true)
 
-    n_samples = (~np.isnan(samples)).sum(0)
-
-    samples = np.sort(samples, axis=0)
-    diff = samples[1:] - samples[:-1]
-
-    counting_up = np.ones_like(samples).cumsum(0)[:-1]
-    lhs = counting_up - (np.isnan(samples).sum(0))
-    lhs = np.where(lhs > 0, lhs, np.nan)
-
-    rhs = np.where(~np.isnan(lhs), np.flip(counting_up, 0), np.nan)
-    weight = np.flip(lhs * rhs, 0)
-
-    abs_error = np.nanmean(np.abs(true - samples), 0)
-    return abs_error - (np.nansum(diff * weight, axis=0) / n_samples**2)
+    # CRPS(F, y) = E|X − y| − ½·E|X − X′| for the empirical F. The pairwise
+    # term decomposes over gaps between consecutive order statistics: the gap
+    # above rank k is crossed by exactly k·(n − k) of the n² ordered pairs,
+    # so ½·E|X − X′| = Σ_k gap_k · k·(n − k) / n². NaN draws sort below every
+    # rank; ranks past the valid block get k·(n − k) ≤ 0 and are excluded.
+    # (Same estimator the reference inherits from pyro's ``crps_empirical``;
+    # derived independently here.)
+    n_valid = (~np.isnan(samples)).sum(0)
+    ordered = np.sort(samples, axis=0)
+    gaps = ordered[1:] - ordered[:-1]
+    rank = np.arange(1, samples.shape[0]).reshape((-1,) + (1,) * true.ndim)
+    pairs_crossing = rank * (n_valid - rank)
+    spread = np.where(pairs_crossing > 0, gaps * pairs_crossing, 0.0).sum(0)
+    mean_abs_err = np.nanmean(np.abs(true - samples), axis=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return mean_abs_err - spread / n_valid.astype(float) ** 2
 
 
 def eval_range(rng: bool | RANGE_T, val: np.ndarray) -> np.ndarray:
