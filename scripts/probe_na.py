@@ -1,0 +1,147 @@
+"""Device op-level profile of the NestedAttention train step (VERDICT r05 #2).
+
+Same protocol as ``profile_width.py`` (hlo_stats from a jax.profiler trace)
+at the bench NA shape (B=32, L=256, hidden 256, 2 layers, 3 dep-graph
+levels), plus the CI step at the identical shape for a side-by-side op
+attribution of the NA-vs-CI cost ratio.
+
+    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python scripts/probe_na.py
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+from profile_width import top_ops_from_trace  # noqa: E402
+
+BATCH, SEQ_LEN, HIDDEN = 32, 256, 256
+
+
+def build(na: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from eventstreamgpt_tpu.data import JaxDataset, PytorchDatasetConfig
+    from eventstreamgpt_tpu.data.synthetic import write_synthetic_dataset
+    from eventstreamgpt_tpu.models.config import OptimizationConfig, StructuredTransformerConfig
+    from eventstreamgpt_tpu.training import (
+        TrainState,
+        build_model,
+        build_optimizer,
+        data_parallel_mesh,
+        make_train_step,
+        replicate,
+        shard_batch,
+    )
+
+    data_dir = Path(tempfile.mkdtemp(prefix="esgpt_profile_na_"))
+    write_synthetic_dataset(
+        data_dir,
+        n_subjects_per_split={"train": 64},
+        n_event_types=40,
+        n_labs=3500,
+        n_meds=500,
+        mean_seq_len=200,
+        max_seq_len=512,
+        seed=0,
+    )
+    train_ds = JaxDataset(
+        PytorchDatasetConfig(save_dir=data_dir, max_seq_len=SEQ_LEN, min_seq_len=4), "train"
+    )
+    kwargs = dict(
+        hidden_size=HIDDEN,
+        head_dim=HIDDEN // 4,
+        num_attention_heads=4,
+        num_hidden_layers=2,
+        seq_attention_types=["local", "global"],
+        seq_window_size=32,
+        intermediate_size=HIDDEN * 4,
+        TTE_generation_layer_type="log_normal_mixture",
+        TTE_lognormal_generation_num_components=3,
+        precision="bf16",
+    )
+    if na:
+        kwargs.update(
+            structured_event_processing_mode="nested_attention",
+            measurements_per_dep_graph_level=[[], ["event_type"], ["lab", "med"]],
+            dep_graph_attention_types="global",
+            do_full_block_in_seq_attention=False,
+            do_full_block_in_dep_graph_attention=True,
+        )
+    config = StructuredTransformerConfig(**kwargs)
+    config.set_to_dataset(train_ds)
+    model = build_model(config)
+    oc = OptimizationConfig(init_lr=1e-3, batch_size=BATCH, max_epochs=1)
+    oc.set_to_dataset(train_ds)
+    tx, _ = build_optimizer(oc)
+    batch = next(train_ds.batches(BATCH, shuffle=True, seed=0))
+    params = model.init(jax.random.PRNGKey(0), batch)
+    mesh = data_parallel_mesh(BATCH)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
+    state = replicate(state, mesh)
+    resident = shard_batch(batch, mesh)
+    return make_train_step(model, tx), state, resident
+
+
+def profile(name: str, na: bool, steps: int = 8):
+    import jax
+
+    from eventstreamgpt_tpu.utils.benchmarking import drain, sustained_step_ms
+
+    step, state, resident = build(na)
+    rng = jax.random.PRNGKey(0)
+    state, loss = step(state, resident, rng)
+    drain(loss)
+    step_ms, state, _ = sustained_step_ms(step, state, resident, rng)
+    print(f"{name}: sustained {step_ms:.2f} ms/step", file=sys.stderr)
+
+    trace_dir = tempfile.mkdtemp(prefix=f"esgpt_trace_{name}_")
+    jax.profiler.start_trace(trace_dir)
+    for _ in range(steps):
+        state, loss = step(state, resident, rng)
+    drain(loss)
+    jax.profiler.stop_trace()
+
+    tool, rows = top_ops_from_trace(trace_dir)
+    out = []
+    if isinstance(rows, (str, bytes)):
+        import json as _json
+
+        rows = _json.loads(rows)
+    return step_ms, rows
+
+
+def summarize(rows, top=25):
+    """hlo_stats rows -> [(self_us_per_occurrence-ish aggregates)]."""
+    # hlo_stats schema: list of dicts with keys incl. 'HLO op name',
+    # 'Self time (us)', 'Occurrences', 'Category'... be permissive.
+    if isinstance(rows, dict):
+        rows = rows.get("data", rows)
+    agg = {}
+    for r in rows:
+        if not isinstance(r, dict):
+            continue
+        cat = r.get("category") or r.get("Category") or "?"
+        t = float(r.get("total_self_time_us") or r.get("Self time (us)") or r.get("self_time_us") or 0)
+        agg[cat] = agg.get(cat, 0.0) + t
+    return sorted(agg.items(), key=lambda kv: -kv[1])[:top]
+
+
+def main():
+    na_ms, na_rows = profile("na", na=True)
+    ci_ms, ci_rows = profile("ci", na=False)
+    print(f"\nNA {na_ms:.2f} ms vs CI {ci_ms:.2f} ms -> ratio {na_ms/ci_ms:.2f}")
+    print("\n-- NA by category (self us over traced steps) --")
+    for k, v in summarize(na_rows):
+        print(f"  {v:10.0f}  {k}")
+    print("\n-- CI by category --")
+    for k, v in summarize(ci_rows):
+        print(f"  {v:10.0f}  {k}")
+
+
+if __name__ == "__main__":
+    main()
