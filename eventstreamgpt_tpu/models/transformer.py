@@ -221,17 +221,30 @@ class InnerSelfAttention(nn.Module):
 
         B, S = hidden_states.shape[0], hidden_states.shape[1]
 
+        # Projections stay in (B, S, H, D) — the matmul's natural layout. The
+        # transpose to heads-first (B, H, S, D) happens ONLY for consumers
+        # whose contract needs it (KV caches, the fused kernels); the einsum
+        # fallback contracts directly from (B, S, H, D), which removes the
+        # q/k/v/output transposes that dominated the NA dep-graph blocks'
+        # "data formatting" time in the r05 profile (scripts/probe_na.py:
+        # tiny G-wide graphs pay relayout copies comparable to their matmuls).
         def split_heads(x):
-            return x.reshape(x.shape[:-1] + (num_heads, head_dim)).swapaxes(-3, -2)
+            return x.reshape(x.shape[:-1] + (num_heads, head_dim))
 
-        query = split_heads(q_proj(hidden_states))  # (B, H, S, D)
+        query = split_heads(q_proj(hidden_states))  # (B, S, H, D)
         key = split_heads(k_proj(hidden_states))
         value = split_heads(v_proj(hidden_states))
 
         if static_kv_first:
-            query = query[:, :, 1:, :]
+            query = query[:, 1:]
 
-        q_len = query.shape[2]
+        q_len = query.shape[1]
+
+        def heads_first(x):
+            return x.swapaxes(-3, -2)  # (B, S, H, D) -> (B, H, S, D)
+
+        if layer_past is not None or use_cache:
+            query, key, value = heads_first(query), heads_first(key), heads_first(value)
 
         present = None
         if layer_past is not None:
@@ -336,6 +349,9 @@ class InnerSelfAttention(nn.Module):
             )
             pad_mask = attention_mask if attention_mask is not None else jnp.ones((B, S), bool)
             seg = jnp.where(pad_mask, base_seg.astype(jnp.int32), -1)
+            # The fused kernels' contract is heads-first (B, H, S, D); fused
+            # paths exclude the cache branches, so this is the only transpose.
+            query, key, value = heads_first(query), heads_first(key), heads_first(value)
 
         if ring_ctx is not None:
             from ..parallel.ring_attention import ring_attention
@@ -352,7 +368,7 @@ class InnerSelfAttention(nn.Module):
                 head_axis=ring_ctx.head_axis,
                 window_size=window,
             )
-            outputs = {"present_key_value": None}
+            outputs = {"present_key_value": None, "_heads_first_out": True}
         elif use_pallas:
             from jax.experimental.pallas.ops.tpu.flash_attention import (
                 BlockSizes,
@@ -397,12 +413,12 @@ class InnerSelfAttention(nn.Module):
                 sm_scale=1.0,
                 block_sizes=block_sizes,
             ).astype(value.dtype)
-            outputs = {"present_key_value": None}
+            outputs = {"present_key_value": None, "_heads_first_out": True}
         elif use_band:
             from ..ops.band_attention import band_local_attention
 
             attn_output = band_local_attention(query, key, value, seg, self.window_size)
-            outputs = {"present_key_value": None}
+            outputs = {"present_key_value": None, "_heads_first_out": True}
         elif use_splash:
             from jax.experimental.pallas.ops.tpu.splash_attention import (
                 splash_attention_kernel as splash_kernel,
@@ -433,16 +449,22 @@ class InnerSelfAttention(nn.Module):
                 value.astype(kernel_dt),
                 seg,
             ).astype(value.dtype)
-            outputs = {"present_key_value": None}
+            outputs = {"present_key_value": None, "_heads_first_out": True}
         else:
             window = self.window_size if self.attention_type == "local" else None
             causal = make_causal_mask(q_positions, k_positions, window)  # (Q, K)
 
+            # Layout: cached paths carry (B, H, S, D); the uncached fallback
+            # stays (B, S, H, D) and contracts heads in place — no relayout.
+            bhsd = layer_past is not None or use_cache
             # fp32 logits for numerical parity with the reference. Under bf16
             # the multiply stays on the MXU in bf16 with fp32 accumulation
             # (preferred_element_type) instead of upcasting the operands.
             attn_weights = jnp.einsum(
-                "bhqd,bhkd->bhqk", query, key, preferred_element_type=jnp.float32
+                "bhqd,bhkd->bhqk" if bhsd else "bqhd,bkhd->bhqk",
+                query,
+                key,
+                preferred_element_type=jnp.float32,
             )
             mask = causal[None, None]
             if valid_k is not None:
@@ -472,13 +494,21 @@ class InnerSelfAttention(nn.Module):
             attn_dropout = nn.Dropout(rate=float(cfg.attention_dropout), name="attn_dropout")
             attn_weights = attn_dropout(attn_weights, deterministic=not self.has_rng("dropout"))
 
-            attn_output = jnp.einsum("bhqk,bhkd->bhqd", attn_weights, value)
-            outputs = {"present_key_value": present}
+            if bhsd:
+                attn_output = jnp.einsum("bhqk,bhkd->bhqd", attn_weights, value)
+            else:
+                # (B, q, H, D) out: merges heads with a plain reshape below.
+                attn_output = jnp.einsum("bhqk,bkhd->bqhd", attn_weights, value)
+            outputs = {"present_key_value": present, "_heads_first_out": bhsd}
             if output_attentions:
                 outputs["attn_weights"] = attn_weights
 
-        # Shared tail: merge heads, project, residual dropout.
-        attn_output = attn_output.swapaxes(-3, -2).reshape(B, q_len, embed_dim)
+        # Shared tail: merge heads, project, residual dropout. Fused-kernel
+        # and cached outputs are heads-first and need the swap; the uncached
+        # einsum output is already (B, q, H, D).
+        if outputs.pop("_heads_first_out"):
+            attn_output = attn_output.swapaxes(-3, -2)
+        attn_output = attn_output.reshape(B, q_len, embed_dim)
         attn_output = out_proj(attn_output)
         resid_dropout = nn.Dropout(rate=float(cfg.resid_dropout), name="resid_dropout")
         attn_output = resid_dropout(attn_output, deterministic=not self.has_rng("dropout"))
