@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from ..data.jax_dataset import JaxDataset
+from ..data.device_dataset import DeviceDataset
 from ..data.prefetch import prefetch_to_device
 from ..generation import generate
 from ..models.config import Split, StructuredTransformerConfig
@@ -53,6 +54,7 @@ def get_generative_predictions(
     max_new_events: int,
     use_cache: bool = True,
     mesh=None,
+    do_validate_batch: bool = True,
 ):
     """Generates, labels, and averages into empirical label probabilities.
 
@@ -71,6 +73,7 @@ def get_generative_predictions(
         num_return_sequences=num_samples,
         use_cache=use_cache,
         mesh=mesh,
+        do_validate_batch=do_validate_batch,
     )
     empirical_labels, labels_unpredicted = labeling_function(
         generated, input_seq_len=batch.sequence_length
@@ -159,14 +162,32 @@ def zero_shot_evaluation(
     for split, dataset in ((Split.TUNING, tuning_pyd), (Split.HELD_OUT, held_out_pyd)):
         metrics = StreamClassificationMetrics(config, split)
         frac_unpredictable: list[np.ndarray] = []
-        # Collation runs in the prefetcher's worker thread, overlapping the
-        # (device-bound) generation of the previous batch. Placement stays on
-        # the host — generate() expands the batch by num_return_sequences
-        # before sharding it over the mesh itself.
-        batch_iter = prefetch_to_device(
-            dataset.batches(batch_size, shuffle=False, drop_last=False, seed=0),
-            lambda b: b,
-        )
+        # Prompts collate ON DEVICE when the dataset fits HBM residency
+        # (data/device_dataset.py): generate() then receives resident arrays
+        # and its wrapper pays no per-batch wire transfer — at r05 bench
+        # shapes the transfer was ~5x the fused generation program itself.
+        # Oversized cohorts fall back to host collation in a prefetch thread.
+        # No mesh here: the data mesh is sized for the num_samples-expanded
+        # batch, which generate() itself expands and shards; prompts collate
+        # unsharded.
+        try:
+            device_ds = DeviceDataset(dataset)
+        except ValueError:
+            device_ds = None
+        if device_ds is not None:
+            batch_iter = (
+                (b, None)
+                for b in device_ds.batches(batch_size, shuffle=False, drop_last=False, seed=0)
+            )
+        else:
+            # Collation runs in the prefetcher's worker thread, overlapping
+            # the (device-bound) generation of the previous batch. Placement
+            # stays on the host — generate() expands the batch by
+            # num_return_sequences before sharding it over the mesh itself.
+            batch_iter = prefetch_to_device(
+                dataset.batches(batch_size, shuffle=False, drop_last=False, seed=0),
+                lambda b: b,
+            )
         try:
             for batch, _ in batch_iter:
                 key, sub = jax.random.split(key)
@@ -180,6 +201,10 @@ def zero_shot_evaluation(
                     num_samples=num_samples,
                     max_new_events=max_new_events,
                     mesh=mesh,
+                    # Resident framework-collated prompts are NaN-clean by
+                    # construction; the device-side validity readback costs
+                    # a tunnel round trip per batch.
+                    do_validate_batch=device_ds is None,
                 )
                 if len(out.labels):
                     metrics.update(out)

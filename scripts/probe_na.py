@@ -116,18 +116,15 @@ def profile(name: str, na: bool, steps: int = 8):
 
 
 def summarize(rows, top=25):
-    """hlo_stats rows -> [(self_us_per_occurrence-ish aggregates)]."""
-    # hlo_stats schema: list of dicts with keys incl. 'HLO op name',
-    # 'Self time (us)', 'Occurrences', 'Category'... be permissive.
-    if isinstance(rows, dict):
-        rows = rows.get("data", rows)
+    """hlo_stats table ({cols, rows} gviz-style) -> [(category, self_us)]."""
+    cols = [c["label"] if isinstance(c, dict) else c for c in rows["cols"]]
+    i_cat = cols.index("HLO op category")
+    i_self = cols.index("Total self time (us)")
     agg = {}
-    for r in rows:
-        if not isinstance(r, dict):
-            continue
-        cat = r.get("category") or r.get("Category") or "?"
-        t = float(r.get("total_self_time_us") or r.get("Self time (us)") or r.get("self_time_us") or 0)
-        agg[cat] = agg.get(cat, 0.0) + t
+    for r in rows["rows"]:
+        c = r["c"] if isinstance(r, dict) else r
+        vals = [x.get("v") if isinstance(x, dict) else x for x in c]
+        agg[vals[i_cat]] = agg.get(vals[i_cat], 0.0) + float(vals[i_self] or 0)
     return sorted(agg.items(), key=lambda kv: -kv[1])[:top]
 
 
