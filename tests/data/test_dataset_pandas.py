@@ -476,3 +476,50 @@ class TestPreprocessors:
         per_row = {k: np.full(5, v) for k, v in p.items()}
         out = S.predict(np.asarray([1.0, 2.0, 3.0, 4.0, 5.0]), per_row)
         assert out.tolist() == [True, False, False, False, True]
+
+
+class TestParallelETL:
+    """n_workers > 1 must produce byte-identical outputs to the serial path.
+
+    The subject-sharded DL cache and the per-measurement transform pool
+    (dataset_base.py `_fork_map`) exist for multi-core hosts (the reference
+    gets the analogous parallelism from Polars' Rust threadpool); on any
+    worker count the artifacts must match the serial build exactly.
+    """
+
+    @pytest.fixture(scope="class")
+    def two_datasets(self, tmp_path_factory):
+        built = []
+        for tag, n_workers in (("serial", 1), ("pooled", 3)):
+            save_dir = tmp_path_factory.mktemp(f"etl_{tag}") / "sample"
+            ESD = build_sample_dataset(save_dir)
+            ESD.split([0.8, 0.1], seed=1)
+            ESD.preprocess(n_workers=n_workers)
+            ESD.save(do_overwrite=True)
+            ESD.cache_deep_learning_representation(do_overwrite=True, n_workers=n_workers)
+            built.append(ESD)
+        return built
+
+    def test_transformed_frames_identical(self, two_datasets):
+        serial, pooled = two_datasets
+        for attr in ("subjects_df", "events_df", "dynamic_measurements_df"):
+            a, b = getattr(serial, attr), getattr(pooled, attr)
+            pd.testing.assert_frame_equal(a, b)
+
+    def test_dl_cache_identical(self, two_datasets):
+        serial, pooled = two_datasets
+        s_dir = Path(serial.config.save_dir) / "DL_reps"
+        p_dir = Path(pooled.config.save_dir) / "DL_reps"
+        s_files = sorted(fp.name for fp in s_dir.glob("*.parquet"))
+        p_files = sorted(fp.name for fp in p_dir.glob("*.parquet"))
+        assert s_files == p_files and s_files
+        for name in s_files:
+            pd.testing.assert_frame_equal(
+                pd.read_parquet(s_dir / name), pd.read_parquet(p_dir / name)
+            )
+
+    def test_sharded_build_matches_direct(self, two_datasets):
+        serial, _ = two_datasets
+        direct = serial.build_DL_cached_representation()
+        sharded = serial._build_dl_rep_sharded(None, n_workers=3)
+        pd.testing.assert_frame_equal(direct, sharded)
