@@ -9,10 +9,13 @@ results — communication overlaps compute and no device ever materializes
 the full sequence.
 """
 
+from .collectives_audit import audit_step, collective_inventory
 from .context import current_ring_context, ring_context
 from .ring_attention import ring_attention, ring_attention_shard
 
 __all__ = [
+    "audit_step",
+    "collective_inventory",
     "current_ring_context",
     "ring_attention",
     "ring_attention_shard",

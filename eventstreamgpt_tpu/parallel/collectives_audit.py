@@ -1,0 +1,103 @@
+"""Communication audit: collective inventory of a compiled sharded program.
+
+VERDICT r05 #4: the multi-chip dry run proves the parallel layouts *execute*;
+this module quantifies what they *communicate* — without hardware. The
+compiled HLO names every collective XLA GSPMD inserted (op kind + output
+shape), so per-layout communication volume is a static property of the
+executable:
+
+* ``collective_inventory(hlo_text)`` → per-kind op counts and payload bytes
+  (from the collective outputs' shapes) plus a total.
+* ``audit_step(jitted, *args)`` → AOT-lowers and compiles the step, returns
+  ``(compiled, inventory)`` so callers can both inspect and execute the very
+  same executable.
+
+Used by ``__graft_entry__.dryrun_multichip`` (per-layout inventories in the
+dry-run output and ``COLLECTIVES.json``) and by the ring-attention
+communication test, which asserts the ring's per-step transfer stays
+O(kv-block) — e.g. an accidental full-sequence all-gather in the attention
+or a vocab-sharded head gathering its logits would show up here as a
+payload-bytes blowup long before any hardware run.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+__all__ = ["collective_inventory", "audit_step", "COLLECTIVE_KINDS"]
+
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+_OP_RE = re.compile(
+    r"=\s*(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?P<start>-start)?\("
+)
+
+
+def _shapes_bytes(shape_str: str) -> int:
+    """Total bytes of one HLO result type (scalar, array, or tuple)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = math.prod(int(d) for d in dims.split(",") if d) if dims else 1
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def collective_inventory(hlo_text: str) -> dict:
+    """Parses optimized HLO into per-collective-kind counts and bytes.
+
+    Async pairs count once (the ``-start`` op carries the shape; ``-done``
+    is skipped). ``bytes`` is the payload size of each collective's output —
+    for an all-gather that is the gathered (global) tensor, for a
+    collective-permute the per-hop block.
+    """
+    inv = {kind: {"count": 0, "bytes": 0, "max_bytes": 0} for kind in COLLECTIVE_KINDS}
+    for line in hlo_text.splitlines():
+        if "-done" in line:
+            continue
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        kind = m.group("kind")
+        shape = m.group("shape")
+        b = _shapes_bytes(shape)
+        if m.group("start") and shape.startswith("("):
+            # all-reduce-start outputs (operand, result) tuples; halve so the
+            # payload counts once.
+            b //= 2
+        inv[kind]["bytes"] += b
+        inv[kind]["max_bytes"] = max(inv[kind]["max_bytes"], b)
+        inv[kind]["count"] += 1
+    inv["total_bytes"] = sum(v["bytes"] for v in inv.values() if isinstance(v, dict))
+    inv["total_count"] = sum(v["count"] for v in inv.values() if isinstance(v, dict))
+    return inv
+
+
+def audit_step(jitted_fn, *args, **kwargs):
+    """AOT-compiles ``jitted_fn(*args)`` and returns ``(compiled, inventory)``.
+
+    The compiled executable is callable with the same arguments (donation
+    semantics preserved), so callers pay one compile for both the audit and
+    the execution.
+    """
+    compiled = jitted_fn.lower(*args, **kwargs).compile()
+    return compiled, collective_inventory(compiled.as_text())
