@@ -6,9 +6,14 @@ are static PNGs instead of interactive HTML, same plot families):
 
 * by-time curves (``plot_by_time``): active subjects, cumulative subjects,
   cumulative events, events/subject, events/(subject·time), each optionally
-  split by static covariates;
+  split by static covariates (reference ``plot_counts_over_time``);
 * by-age curves (``plot_by_age``): cumulative subjects, cumulative events,
-  events/subject over age buckets.
+  events/subject over age buckets (reference ``plot_counts_over_age``);
+* events-per-subject histogram (reference ``plot_events_per_patient:417``);
+* age distribution of active subjects over time as a median + interquartile
+  band (reference ``plot_age_distribution_over_time:254``);
+* static-covariate breakdown bars (reference
+  ``plot_static_variables_breakdown:327``).
 
 The class is both configuration (JSONable, reference-matching validation) and
 executor: ``plot(dataset, save_dir)`` writes one PNG per plot family.
@@ -223,6 +228,77 @@ class Visualizer(JSONableMixin):
             fig.savefig(fp, dpi=100)
             plt.close(fig)
             written.append(fp)
+
+        # Events-per-subject histogram (reference plot_events_per_patient).
+        fig, ax = plt.subplots(figsize=(6, 4))
+        for label, grp in self._groups(spans, self.static_covariates):
+            ax.hist(grp["n_events"].to_numpy(), bins=30, alpha=0.6, label=label)
+        ax.set_title("Events per Subject")
+        ax.set_xlabel("# of events")
+        ax.set_ylabel("# of subjects")
+        ax.legend(fontsize=6)
+        fig.tight_layout()
+        fp = save_dir / "dataset_events_per_subject.png"
+        fig.savefig(fp, dpi=100)
+        plt.close(fig)
+        written.append(fp)
+
+        # Static-covariate breakdown (reference plot_static_variables_breakdown).
+        if self.static_covariates:
+            fig, axes = plt.subplots(
+                1, len(self.static_covariates), figsize=(5 * len(self.static_covariates), 4),
+                squeeze=False,
+            )
+            for ax, cov in zip(axes[0], self.static_covariates):
+                counts = dataset.subjects_df[cov].value_counts()
+                ax.bar([str(v) for v in counts.index], counts.to_numpy())
+                ax.set_title(f"Subjects by {cov}")
+                ax.tick_params(axis="x", rotation=45)
+            fig.tight_layout()
+            fp = save_dir / "dataset_static_breakdown.png"
+            fig.savefig(fp, dpi=100)
+            plt.close(fig)
+            written.append(fp)
+
+        # Age distribution of active subjects over time: median + IQR band
+        # (reference plot_age_distribution_over_time).
+        if self.plot_by_age and self.dob_col is not None:
+            dob = pd.to_datetime(dataset.subjects_df.set_index("subject_id")[self.dob_col])
+            sp = spans.merge(
+                dob.rename("dob"), left_on="subject_id", right_index=True, how="inner"
+            ).dropna(subset=["dob"])
+            if len(sp) >= (self.min_sub_to_plot_age_dist or 0):
+                grid = pd.date_range(sp["first"].min(), sp["last"].max(), periods=60)
+                fig, ax = plt.subplots(figsize=(7, 4))
+                for label, grp in self._groups(sp, self.static_covariates):
+                    q25, q50, q75, xs = [], [], [], []
+                    firsts = grp["first"].to_numpy()
+                    lasts = grp["last"].to_numpy()
+                    dobs = grp["dob"].to_numpy()
+                    for t in grid:
+                        t64 = t.to_datetime64()
+                        active = (firsts <= t64) & (lasts >= t64)
+                        if active.sum() < 2:
+                            continue
+                        ages = (t64 - dobs[active]) / np.timedelta64(1, "D") / 365.25
+                        lo, mid, hi = np.quantile(ages, (0.25, 0.5, 0.75))
+                        xs.append(t)
+                        q25.append(lo)
+                        q50.append(mid)
+                        q75.append(hi)
+                    if xs:
+                        (line,) = ax.plot(xs, q50, label=label)
+                        ax.fill_between(xs, q25, q75, alpha=0.2, color=line.get_color())
+                ax.set_title("Age of Active Subjects over Time (median, IQR)")
+                ax.set_xlabel("time")
+                ax.set_ylabel("age (years)")
+                ax.tick_params(axis="x", rotation=45)
+                ax.legend(fontsize=6)
+                fig.tight_layout()
+                fp = save_dir / "dataset_age_distribution.png"
+                fig.savefig(fp, dpi=100)
+                plt.close(fig)
+                written.append(fp)
 
         return written
 

@@ -290,16 +290,22 @@ def generate(
         if mode == StructuredEventProcessingMode.CONDITIONALLY_INDEPENDENT
         else _generate_na
     )
-    result = gen(
-        model,
-        params,
-        batch,
-        config,
-        key,
-        max_new_events,
-        use_cache,
-        stopping_criteria=stopping_criteria,
-    )
+    try:
+        result = gen(
+            model,
+            params,
+            batch,
+            config,
+            key,
+            max_new_events,
+            use_cache,
+            stopping_criteria=stopping_criteria,
+        )
+    except Exception:
+        # A non-finite prompt can crash generation itself; surface the clear
+        # validation error instead of the downstream failure (ADVICE r04).
+        _check_prompt()
+        raise
     _check_prompt()
     return result
 

@@ -431,6 +431,10 @@ class StructuredTransformerConfig(JSONableMixin):
                 f"attention_implementation must be 'einsum', 'pallas_flash', or 'ring'; got "
                 f"{attention_implementation}"
             )
+        # Cross-backend note (ADVICE r04): under 'pallas_flash', narrow-window
+        # local layers use the backend-independent band einsum on CPU too, so
+        # off-TPU evals of pallas_flash checkpoints are fp32-rounding-close to
+        # TPU, not bit-exact; 'einsum' remains the bit-exact-everywhere path.
         self.attention_implementation = attention_implementation
         # Rematerialization policy for the encoder blocks (VERDICT r05 #3).
         # "none" saves all activations (fastest when they fit HBM — the

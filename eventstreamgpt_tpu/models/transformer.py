@@ -387,7 +387,10 @@ class InnerSelfAttention(nn.Module):
             # that divides the sequence length; otherwise keep the kernel's
             # defaults.
             head_dim = query.shape[-1]
-            preferred = (1024, 512, 256) if head_dim >= 128 else (512, 256, 128)
+            # 128 closes the ladder in both branches so short sequences
+            # (S=128) still pin explicit blocks instead of silently falling
+            # to kernel defaults (ADVICE r04).
+            preferred = (1024, 512, 256, 128) if head_dim >= 128 else (512, 256, 128)
             bn = next((b for b in preferred if b <= S and S % b == 0), None)
             block_sizes = (
                 BlockSizes(
@@ -417,6 +420,10 @@ class InnerSelfAttention(nn.Module):
         elif use_band:
             from ..ops.band_attention import band_local_attention
 
+            # chunk_size is left at its default C=window — the settled
+            # production choice: fatter chunks win layer microbenches but
+            # lose the interleaved step-level A/B (BASELINE.md); the knob
+            # stays for per-deployment tuning via probes.
             attn_output = band_local_attention(query, key, value, seg, self.window_size)
             outputs = {"present_key_value": None, "_heads_first_out": True}
         elif use_splash:

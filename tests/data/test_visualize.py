@@ -48,10 +48,21 @@ class TestPlots:
             age_col="age",
             dob_col="dob",
             n_age_buckets=20,
+            min_sub_to_plot_age_dist=2,
         )
         written = built.visualize(v, tmp_path)
         assert (tmp_path / "dataset_by_age.png").exists()
-        assert len(written) == 1
+        # Reference-parity dashboard variants (VERDICT r05 #9): events-per-
+        # subject histogram always; age-distribution band when dob is known.
+        assert (tmp_path / "dataset_events_per_subject.png").exists()
+        assert (tmp_path / "dataset_age_distribution.png").exists()
+        assert all(fp.stat().st_size > 1000 for fp in written)
+
+    def test_static_breakdown_panel(self, built, tmp_path):
+        v = Visualizer(plot_by_time=False, static_covariates=["eye_color"])
+        built.visualize(v, tmp_path)
+        assert (tmp_path / "dataset_static_breakdown.png").exists()
+        assert (tmp_path / "dataset_events_per_subject.png").exists()
 
     def test_subset_sampling(self, built, tmp_path):
         v = Visualizer(subset_size=10, subset_random_seed=1)
