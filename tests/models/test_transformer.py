@@ -193,3 +193,36 @@ class TestCIEncoder:
         np.testing.assert_allclose(
             np.asarray(out1.last_hidden_state), np.asarray(out2.last_hidden_state), rtol=1e-5
         )
+
+
+class TestRematPolicies:
+    """Every gradient_checkpointing policy computes identical loss + grads.
+
+    Rematerialization only changes WHAT is recomputed in the backward, never
+    the math; the r05 width A/B (scripts/probe_remat.py, BASELINE.md) picks
+    speed, this pins correctness.
+    """
+
+    def test_policies_match_no_remat(self):
+        batch = make_batch()
+        ref_grads = None
+        for policy in ("none", "block", "dots", "dots_no_batch"):
+            config = small_config(gradient_checkpointing=policy)
+            model = ConditionallyIndependentPointProcessTransformer(config)
+            params = model.init(jax.random.PRNGKey(0), batch)
+
+            def loss_fn(p):
+                out = model.apply(p, batch)
+                return (out.last_hidden_state.astype(jnp.float32) ** 2).sum()
+
+            loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+            if ref_grads is None:
+                ref_loss, ref_grads = loss, grads
+                continue
+            np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+            for g, r in zip(
+                jax.tree_util.tree_leaves(grads), jax.tree_util.tree_leaves(ref_grads)
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(r), rtol=1e-5, atol=1e-6
+                )
