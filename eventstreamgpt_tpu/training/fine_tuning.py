@@ -336,9 +336,39 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     train_step = jax.jit(train_step, donate_argnums=(0,))
     eval_step = jax.jit(lambda params, batch: model.apply(params, batch))
 
+    # Device-resident batches (r05 feed-path redesign): collate on device
+    # from ~100-byte plans — stream labels ride along as host arrays — with
+    # the host prefetch pipeline as the oversized-cohort fallback. Few-shot
+    # fine-tuning cohorts essentially always fit the budget.
+    from ..data.device_dataset import DeviceDataset
+
+    def _resident(pyd):
+        if (
+            jax.process_count() != 1
+            or DeviceDataset.estimate_nbytes(pyd) > 2 * 1024**3
+        ):
+            return None
+        try:
+            return DeviceDataset(pyd, mesh=mesh)
+        except ValueError:
+            return None
+
+    device_train = _resident(train_pyd)
+    _device_eval_cache: dict[int, "DeviceDataset | None"] = {}
+
     def evaluate(params, dataset, split) -> dict[str, float]:
         metrics = StreamClassificationMetrics(config, split)
         # seed=0 pins random subsequence crops: eval passes must be comparable.
+        if id(dataset) not in _device_eval_cache:
+            _device_eval_cache[id(dataset)] = _resident(dataset)
+        dd = _device_eval_cache[id(dataset)]
+        if dd is not None:
+            for batch in dd.batches(
+                oc.validation_batch_size, shuffle=False, drop_last=False, seed=0
+            ):
+                out = eval_step(params, batch)
+                metrics.update(out, n_valid=int(np.asarray(batch.valid_mask).sum()))
+            return metrics.compute()
         batch_iter = prefetch_to_device(
             dataset.batches(oc.validation_batch_size, shuffle=False, drop_last=False, seed=0),
             lambda b: shard_batch(b, mesh),
@@ -377,10 +407,18 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     for epoch in range(oc.max_epochs):
         epoch_t0 = time.perf_counter()
         window_losses = []
-        batch_iter = prefetch_to_device(
-            train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed + epoch),
-            lambda b: shard_batch(b, mesh),
-        )
+        if device_train is not None:
+            batch_iter = (
+                (b, None)
+                for b in device_train.batches(
+                    oc.batch_size, shuffle=True, seed=cfg.seed + epoch
+                )
+            )
+        else:
+            batch_iter = prefetch_to_device(
+                train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed + epoch),
+                lambda b: shard_batch(b, mesh),
+            )
         try:
             for batch, _ in batch_iter:
                 state, loss = train_step(state, batch, rng)
