@@ -101,19 +101,36 @@ def get_embeddings(cfg: FinetuneConfig) -> dict[str, Path]:
 
     out_dir = Path(cfg.load_from_model_dir) / "embeddings" / (cfg.task_df_name or "all")
     written: dict[str, Path] = {}
+    from ..data.device_dataset import DeviceDataset
+
     for sp in ("train", "tuning", "held_out"):
         dataset = train_pyd if sp == "train" else JaxDataset(cfg.data_config, split=sp)
         chunks = []
-        # Async input pipeline: collation + device_put overlap the previous
-        # batch's encoder forward. valid_mask is captured host-side in the
-        # worker so reading it here costs no device sync.
-        batch_iter = prefetch_to_device(
-            dataset.batches(oc.validation_batch_size, shuffle=False, drop_last=False, seed=0),
-            lambda b: shard_batch(b, mesh),
-            host_stats_fn=lambda b: (
-                np.asarray(b.valid_mask) if b.valid_mask is not None else None
-            ),
-        )
+        # Device-resident batches when the split fits HBM (r05 feed-path
+        # redesign: no per-batch wire transfer); host prefetch otherwise.
+        # valid_mask is a host array either way, so reading it costs no
+        # device sync.
+        dd = None
+        if DeviceDataset.estimate_nbytes(dataset) <= 2 * 1024**3:
+            try:
+                dd = DeviceDataset(dataset, mesh=mesh)
+            except ValueError:
+                dd = None
+        if dd is not None:
+            batch_iter = (
+                (b, np.asarray(b.valid_mask) if b.valid_mask is not None else None)
+                for b in dd.batches(
+                    oc.validation_batch_size, shuffle=False, drop_last=False, seed=0
+                )
+            )
+        else:
+            batch_iter = prefetch_to_device(
+                dataset.batches(oc.validation_batch_size, shuffle=False, drop_last=False, seed=0),
+                lambda b: shard_batch(b, mesh),
+                host_stats_fn=lambda b: (
+                    np.asarray(b.valid_mask) if b.valid_mask is not None else None
+                ),
+            )
         try:
             for batch, valid in batch_iter:
                 emb = np.asarray(embed_step(params, batch))
