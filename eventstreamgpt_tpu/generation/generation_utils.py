@@ -365,8 +365,12 @@ def _model_config_signature(model, config: StructuredTransformerConfig) -> str:
 
 
 def _cached_steps(cache_key: tuple, build):
-    hit = _STEP_CACHE.get(cache_key)
+    hit = _STEP_CACHE.pop(cache_key, None)
     if hit is not None:
+        # Re-insert on hit: eviction below is LRU, so steady-state shapes
+        # (the eval loop's one batch shape) can't be churned out by
+        # one-off shapes (VERDICT r04 weak #8).
+        _STEP_CACHE[cache_key] = hit
         return hit
     steps = build()
     if len(_STEP_CACHE) >= _STEP_CACHE_MAX:
