@@ -104,6 +104,18 @@ class TestPaddedParity:
         for rb, fb in zip(resumed, full[2:]):
             assert_batches_equal(rb, fb)
 
+    def test_capped_max_n_dynamic_clips_like_host(self, sample_dir):
+        """config.max_n_dynamic below the data's true max: the dense tables
+        must clip trailing slots exactly as host collation does."""
+        ds = make_ds(sample_dir, max_n_dynamic=2)
+        assert ds.max_n_dynamic == 2
+        dd = DeviceDataset(ds)
+        for db, hb in zip(
+            dd.batches(3, shuffle=False, seed=0, drop_last=False),
+            ds.batches(3, shuffle=False, seed=0, drop_last=False),
+        ):
+            assert_batches_equal(db, hb)
+
     def test_light_fields_and_counts(self, sample_dir):
         ds = make_ds(
             sample_dir,
