@@ -223,6 +223,10 @@ class DeviceDataset:
             self.arrays = {k: jnp.asarray(v) for k, v in host.items()}
         self._kernel_cache: dict = {}
 
+    # Default HBM budget for auto-residency: conservative against a 16 GB
+    # v5e chip that also holds params, optimizer state, and activations.
+    DEFAULT_BUDGET_BYTES = 2 * 1024**3
+
     @staticmethod
     def estimate_nbytes(dataset: JaxDataset) -> int:
         """Predicted HBM footprint of residency, without building anything.
@@ -235,6 +239,30 @@ class DeviceDataset:
         per_row = 4 + dataset.max_n_dynamic * (4 + 4 + 4 + 1)
         static = 2 * 4 * dataset.max_n_static * max(dataset.data.n_subjects, 1)
         return n_rows * per_row + static + dataset.data.subject_event_offsets.nbytes
+
+    @classmethod
+    def try_create(
+        cls,
+        dataset: JaxDataset,
+        mesh: Mesh | None = None,
+        context_parallel: bool = False,
+        max_bytes: int | None = None,
+    ) -> "DeviceDataset | None":
+        """`DeviceDataset` when residency is eligible, else ``None``.
+
+        The single auto-residency gate every harness shares: single-process
+        runs only, estimated tables within ``max_bytes`` (default
+        `DEFAULT_BUDGET_BYTES`), CSR arrays int32-narrow. Callers fall back
+        to host collation on ``None``.
+        """
+        if jax.process_count() != 1:
+            return None
+        if cls.estimate_nbytes(dataset) > (max_bytes or cls.DEFAULT_BUDGET_BYTES):
+            return None
+        try:
+            return cls(dataset, mesh=mesh, context_parallel=context_parallel)
+        except ValueError:
+            return None
 
     def _build_dense_tables(self) -> dict:
         """CSR → dense per-event tables (see `_RESIDENT_FIELDS` for why)."""

@@ -51,15 +51,24 @@ _OP_RE = re.compile(
 )
 
 
-def _shapes_bytes(shape_str: str) -> int:
-    """Total bytes of one HLO result type (scalar, array, or tuple)."""
-    total = 0
+def _shapes_bytes(shape_str: str, tuple_max: bool = False) -> int:
+    """Bytes of one HLO result type (scalar, array, or tuple).
+
+    ``tuple_max`` takes the LARGEST tuple member instead of the sum — the
+    payload convention for async ``-start`` ops, whose tuples carry
+    (operand, result[, aux]): for all-reduce/collective-permute the members
+    are equal, for all-gather the result (the gathered tensor — this
+    module's payload definition) is the largest.
+    """
+    sizes = []
     for dtype, dims in _SHAPE_RE.findall(shape_str):
         if dtype not in _DTYPE_BYTES:
             continue
         n = math.prod(int(d) for d in dims.split(",") if d) if dims else 1
-        total += n * _DTYPE_BYTES[dtype]
-    return total
+        sizes.append(n * _DTYPE_BYTES[dtype])
+    if not sizes:
+        return 0
+    return max(sizes) if tuple_max else sum(sizes)
 
 
 def collective_inventory(hlo_text: str) -> dict:
@@ -79,11 +88,9 @@ def collective_inventory(hlo_text: str) -> dict:
             continue
         kind = m.group("kind")
         shape = m.group("shape")
-        b = _shapes_bytes(shape)
-        if m.group("start") and shape.startswith("("):
-            # all-reduce-start outputs (operand, result) tuples; halve so the
-            # payload counts once.
-            b //= 2
+        # Async -start ops output (operand, result[, aux]) tuples; the
+        # payload is the result (largest member), counted once.
+        b = _shapes_bytes(shape, tuple_max=bool(m.group("start")) and shape.startswith("("))
         inv[kind]["bytes"] += b
         inv[kind]["max_bytes"] = max(inv[kind]["max_bytes"], b)
         inv[kind]["count"] += 1

@@ -110,12 +110,7 @@ def get_embeddings(cfg: FinetuneConfig) -> dict[str, Path]:
         # redesign: no per-batch wire transfer); host prefetch otherwise.
         # valid_mask is a host array either way, so reading it costs no
         # device sync.
-        dd = None
-        if DeviceDataset.estimate_nbytes(dataset) <= 2 * 1024**3:
-            try:
-                dd = DeviceDataset(dataset, mesh=mesh)
-            except ValueError:
-                dd = None
+        dd = DeviceDataset.try_create(dataset, mesh=mesh)
         if dd is not None:
             batch_iter = (
                 (b, np.asarray(b.valid_mask) if b.valid_mask is not None else None)

@@ -342,25 +342,14 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     # fine-tuning cohorts essentially always fit the budget.
     from ..data.device_dataset import DeviceDataset
 
-    def _resident(pyd):
-        if (
-            jax.process_count() != 1
-            or DeviceDataset.estimate_nbytes(pyd) > 2 * 1024**3
-        ):
-            return None
-        try:
-            return DeviceDataset(pyd, mesh=mesh)
-        except ValueError:
-            return None
-
-    device_train = _resident(train_pyd)
+    device_train = DeviceDataset.try_create(train_pyd, mesh=mesh)
     _device_eval_cache: dict[int, "DeviceDataset | None"] = {}
 
     def evaluate(params, dataset, split) -> dict[str, float]:
         metrics = StreamClassificationMetrics(config, split)
         # seed=0 pins random subsequence crops: eval passes must be comparable.
         if id(dataset) not in _device_eval_cache:
-            _device_eval_cache[id(dataset)] = _resident(dataset)
+            _device_eval_cache[id(dataset)] = DeviceDataset.try_create(dataset, mesh=mesh)
         dd = _device_eval_cache[id(dataset)]
         if dd is not None:
             for batch in dd.batches(

@@ -624,20 +624,21 @@ def train(
     # fit a conservative HBM budget; numerics are bit-identical to the host
     # path (tested), so this is purely a throughput decision.
     resident_mode = tc.get("device_resident_data", "auto")
-    resident_budget = int(tc.get("device_resident_max_bytes") or 2 * 1024**3)
+    resident_budget = int(
+        tc.get("device_resident_max_bytes") or DeviceDataset.DEFAULT_BUDGET_BYTES
+    )
     device_train = device_tuning = None
-    if resident_mode is True or (
-        resident_mode == "auto"
-        and jax.process_count() == 1
-        and DeviceDataset.estimate_nbytes(train_pyd) <= resident_budget
-    ):
-        try:
-            device_train = DeviceDataset(train_pyd, mesh=mesh, context_parallel=n_cp > 1)
-            device_tuning = DeviceDataset(tuning_pyd, mesh=mesh, context_parallel=n_cp > 1)
-        except ValueError:
-            if resident_mode is True:
-                raise
-            device_train = device_tuning = None
+    if resident_mode is True:
+        device_train = DeviceDataset(train_pyd, mesh=mesh, context_parallel=n_cp > 1)
+        device_tuning = DeviceDataset(tuning_pyd, mesh=mesh, context_parallel=n_cp > 1)
+    elif resident_mode == "auto":
+        device_train = DeviceDataset.try_create(
+            train_pyd, mesh=mesh, context_parallel=n_cp > 1, max_bytes=resident_budget
+        )
+        if device_train is not None:
+            device_tuning = DeviceDataset.try_create(
+                tuning_pyd, mesh=mesh, context_parallel=n_cp > 1, max_bytes=resident_budget
+            )
     chunk_steps = tc.get("steps_per_execution") or "auto"
     if chunk_steps == "auto":
         # Align with the logging cadence so windowed records keep their
@@ -701,30 +702,45 @@ def train(
             window_losses: list = []
             epoch_skip = skip_batches if epoch == start_epoch else 0
 
-            def handle_window(step_in_epoch: int, stepped: int):
-                """Shared per-step(s) bookkeeping: logs, checkpoints, stop.
+            def flush_window() -> dict:
+                """Closes the current logging window into a record whose
+                losses stay device arrays (`finalize_record` converts)."""
+                nonlocal window_t0, window_events, window_n, window_losses
+                dt = time.perf_counter() - window_t0
+                rec = {
+                    "split": str(Split.TRAIN),
+                    "epoch": epoch,
+                    "step": global_step,
+                    "_losses": [jnp.atleast_1d(l) for l in window_losses],
+                    "lr": float(lr_schedule(global_step // accum)),
+                    "events_per_sec": window_events / dt if dt > 0 else None,
+                    "step_time_ms": 1000.0 * dt / max(window_n, 1),
+                }
+                window_t0, window_events, window_n = time.perf_counter(), 0, 0
+                window_losses = []
+                return rec
+
+            def finalize_record(rec: dict) -> None:
+                rec["train_loss"] = float(jnp.mean(jnp.concatenate(rec.pop("_losses"))))
+                log_record(rec)
+
+            def handle_window(step_in_epoch: int, stepped: int, pending: list | None = None):
+                """Shared per-dispatch bookkeeping: logs, checkpoints, stop.
 
                 ``stepped`` is how many optimizer-loop steps the last dispatch
                 advanced (1 for the per-batch path, k for a scanned chunk) —
-                cadences fire when the counter crosses a multiple.
+                cadences fire when the counter crosses a multiple. With
+                ``pending``, window records buffer their losses as device
+                arrays for an epoch-end flush (a float() here would block the
+                dispatch pipeline on a data-plane round trip every window).
                 """
-                nonlocal window_t0, window_events, window_n, window_losses, stop
+                nonlocal stop
                 if global_step % log_every < stepped:
-                    dt = time.perf_counter() - window_t0
-                    rec = {
-                        "split": str(Split.TRAIN),
-                        "epoch": epoch,
-                        "step": global_step,
-                        "train_loss": float(jnp.mean(jnp.concatenate(
-                            [jnp.atleast_1d(l) for l in window_losses]
-                        ))),
-                        "lr": float(lr_schedule(global_step // accum)),
-                        "events_per_sec": window_events / dt if dt > 0 else None,
-                        "step_time_ms": 1000.0 * dt / max(window_n, 1),
-                    }
-                    log_record(rec)
-                    window_t0, window_events, window_n = time.perf_counter(), 0, 0
-                    window_losses = []
+                    rec = flush_window()
+                    if pending is None:
+                        finalize_record(rec)
+                    else:
+                        pending.append(rec)
                 if global_step % ckpt_every < stepped:
                     ckpt_mgr.save(
                         global_step,
@@ -745,10 +761,6 @@ def train(
                 # Device-resident scanned training: k collate+step iterations
                 # per dispatch, ~100-byte plans on the wire (the production
                 # fast path; bit-identical numerics to the branch below).
-                # Window log records buffer their losses as device arrays and
-                # flush at epoch end — a float() here would block on a
-                # data-plane round trip every window and stall the dispatch
-                # pipeline.
                 step_in_epoch = epoch_skip
                 pending_logs: list[dict] = []
                 for plans, n_events in train_plan_chunks(epoch, epoch_skip):
@@ -760,7 +772,12 @@ def train(
                             k = remaining
                     if k <= 0:
                         break
-                    if profile_dir and not profiling and 10 <= global_step + k:
+                    # Profile the dispatch(es) overlapping steps [10, 20),
+                    # once — same window as the per-batch path.
+                    if (
+                        profile_dir and not profiling
+                        and global_step < 20 and global_step + k > 10
+                    ):
                         jax.profiler.start_trace(str(profile_dir))
                         profiling = True
                     state, losses = chunked_step(state, device_train.arrays, plans, rng)
@@ -772,40 +789,11 @@ def train(
                     if profiling and global_step >= 20:
                         jax.profiler.stop_trace()
                         profiling = False
-                    if global_step % log_every < k:
-                        dt = time.perf_counter() - window_t0
-                        pending_logs.append(
-                            {
-                                "split": str(Split.TRAIN),
-                                "epoch": epoch,
-                                "step": global_step,
-                                "_losses": jnp.concatenate(window_losses),
-                                "lr": float(lr_schedule(global_step // accum)),
-                                "events_per_sec": window_events / dt if dt > 0 else None,
-                                "step_time_ms": 1000.0 * dt / max(window_n, 1),
-                            }
-                        )
-                        window_t0, window_events, window_n = time.perf_counter(), 0, 0
-                        window_losses = []
-                    if global_step % ckpt_every < k:
-                        ckpt_mgr.save(
-                            global_step,
-                            serialization.to_state_dict(jax.device_get(state)),
-                            metadata={
-                                "epoch": epoch,
-                                "epoch_complete": False,
-                                "step_in_epoch": step_in_epoch,
-                            },
-                        )
-                    if (
-                        oc.max_training_steps is not None
-                        and global_step // accum >= oc.max_training_steps
-                    ):
-                        stop = True
+                    handle_window(step_in_epoch, k, pending_logs)
+                    if stop:
                         break
                 for rec in pending_logs:
-                    rec["train_loss"] = float(jnp.mean(rec.pop("_losses")))
-                    log_record(rec)
+                    finalize_record(rec)
             else:
                 # Asynchronous host input pipeline: collation + device_put run
                 # in a background thread with a depth-2 device buffer, so the
@@ -907,7 +895,9 @@ def train(
 
     held_out_pyd = JaxDataset(cfg.data_config, split="held_out")
     device_held_out = (
-        DeviceDataset(held_out_pyd, mesh=mesh, context_parallel=n_cp > 1)
+        DeviceDataset.try_create(
+            held_out_pyd, mesh=mesh, context_parallel=n_cp > 1, max_bytes=resident_budget
+        )
         if device_train is not None
         else None
     )

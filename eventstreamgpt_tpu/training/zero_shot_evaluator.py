@@ -170,12 +170,7 @@ def zero_shot_evaluation(
         # No mesh here: the data mesh is sized for the num_samples-expanded
         # batch, which generate() itself expands and shards; prompts collate
         # unsharded.
-        device_ds = None
-        if DeviceDataset.estimate_nbytes(dataset) <= 2 * 1024**3:
-            try:
-                device_ds = DeviceDataset(dataset)
-            except ValueError:
-                device_ds = None
+        device_ds = DeviceDataset.try_create(dataset)
         if device_ds is not None:
             batch_iter = (
                 (b, None)

@@ -41,15 +41,21 @@ class TestInventoryParsing:
                 "  %cp = f32[16]{0} collective-permute(f32[16]{0} %z)",
                 "  %cps = (f32[16]{0}, f32[16]{0}) collective-permute-start(f32[16]{0} %z)",
                 "  %cpd = f32[16]{0} collective-permute-done(%cps)",
+                # Async all-gather: tuple members differ; the payload is the
+                # RESULT (gathered tensor), not the member sum halved.
+                "  %ags = (f32[256]{0}, f32[2048]{0}) all-gather-start(f32[256]{0} %w)",
+                "  %agd = f32[2048]{0} all-gather-done(%ags)",
                 "  %other = f32[4]{0} add(f32[4]{0} %a, f32[4]{0} %b)",
             ]
         )
         inv = collective_inventory(txt)
         assert inv["all-reduce"] == {"count": 1, "bytes": 1024, "max_bytes": 1024}
-        assert inv["all-gather"] == {"count": 1, "bytes": 128, "max_bytes": 128}
+        assert inv["all-gather"]["count"] == 2
+        assert inv["all-gather"]["bytes"] == 128 + 2048 * 4
+        assert inv["all-gather"]["max_bytes"] == 2048 * 4
         assert inv["collective-permute"]["count"] == 2
         assert inv["collective-permute"]["bytes"] == 64 + 64
-        assert inv["total_count"] == 4
+        assert inv["total_count"] == 5
 
     def test_dp_training_is_one_gradient_sweep(self):
         """Pure dp: collective bytes == one all-reduce pass over the grads."""
