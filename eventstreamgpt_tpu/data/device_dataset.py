@@ -32,6 +32,20 @@ Light per-subject fields (``subject_id``, ``start_time``, subsequence
 bounds, ``stream_labels``) stay host-computed from the plan: they are O(B)
 bytes, and keeping them on the host preserves bit-exact parity with host
 collation for free.
+
+Multi-host pods (``data_shards > 1``): the dense tables become ONE global
+``jax.Array`` laid out over the mesh's ``data`` axis — subjects are
+partitioned into per-shard pools (`JaxDataset.subject_shards`), each shard's
+tables are stacked along a leading shard axis sharded ``P("data")``, and
+each process materializes/uploads ONLY the shards its addressable devices
+own (``jax.make_array_from_callback``). The plan stream
+(`JaxDataset.plan_batches(n_shards=K)`) deals every batch shard-major —
+``batch_size / K`` rows per pool — from one shared rng stream, so all
+processes derive identical plans and every data-axis shard collates its own
+rows with purely LOCAL gathers (a vmap over the shard axis; GSPMD inserts no
+collectives). The ``skip_batches`` rng-exact resume contract carries over
+unchanged. Single-process stays on the replicated layout and the historical
+global plan stream, bit-for-bit.
 """
 
 from __future__ import annotations
@@ -183,6 +197,13 @@ def packed_collate_kernel(
     return out
 
 
+def _dense_pre_sliced(src, rows, cols, keep, n_rows: int, M: int, dtype) -> np.ndarray:
+    """Dense-table scatter for a source array already sliced to the range."""
+    t = np.zeros((n_rows, M), dtype)
+    t[rows, cols] = np.asarray(src)[keep]
+    return t
+
+
 class DeviceDataset:
     """HBM-resident view of a `JaxDataset` with on-device collation.
 
@@ -190,11 +211,17 @@ class DeviceDataset:
         dataset: the host dataset to mirror. Its CSR index arrays must be
             int32-narrow (`JaxDataset` shrinks them whenever sizes permit; a
             >2B-element cohort would not fit HBM anyway).
-        mesh: optional device mesh. Resident arrays are replicated over it;
+        mesh: optional device mesh. Resident arrays are replicated over it
+            (``data_shards == 1``) or sharded over its ``data`` axis;
             collated batches come out sharded batch-dim-over-``data`` (and,
             with ``context_parallel``, event-dim-over-``context``) — the
             layouts ``shard_batch`` / ``shard_batch_cp`` would have produced.
         context_parallel: emit ring-attention input layout.
+        data_shards: 1 for the replicated single-process layout; the mesh's
+            ``data``-axis size for the sharded (pod) layout, where each
+            data-axis device holds one subject-pool's tables and each process
+            uploads only its addressable shards. Use `create` / `try_create`
+            to pick this from the topology.
     """
 
     def __init__(
@@ -202,10 +229,12 @@ class DeviceDataset:
         dataset: JaxDataset,
         mesh: Mesh | None = None,
         context_parallel: bool = False,
+        data_shards: int = 1,
     ):
         self.dataset = dataset
         self.mesh = mesh
         self.context_parallel = context_parallel
+        self.data_shards = int(data_shards)
         d = dataset.data
         for name in ("subject_event_offsets", "event_data_offsets", "dynamic_indices"):
             if getattr(d, name).dtype == np.int64:
@@ -213,14 +242,52 @@ class DeviceDataset:
                     f"JaxDataset.data.{name} did not narrow to int32 "
                     "(>2^31 elements); such a cohort cannot be device-resident."
                 )
+        # One host-side finiteness pass over the CSR arrays (values are
+        # stored observed-masked, so any non-finite IS an observed value).
+        # This is what lets resident zero-shot prompts skip the per-batch
+        # device-side NaN readback without weakening the guarantee: a
+        # poisoned DL cache fails loudly here, at table-build time.
+        if not np.isfinite(d.time_delta).all():
+            raise ValueError(
+                "non-finite time_delta in the DL cache; refusing to build "
+                "device-resident tables (resident batches skip per-batch NaN "
+                "validation on the strength of this check)."
+            )
+        if not np.isfinite(d.dynamic_values).all():
+            raise ValueError(
+                "non-finite observed dynamic_values in the DL cache; refusing "
+                "to build device-resident tables (resident batches skip "
+                "per-batch NaN validation on the strength of this check)."
+            )
 
-        host = self._build_dense_tables()
-        self.nbytes = sum(a.nbytes for a in host.values())
-        if mesh is not None:
-            replicated = NamedSharding(mesh, P())
-            self.arrays = {k: jax.device_put(v, replicated) for k, v in host.items()}
+        if self.data_shards > 1:
+            if mesh is None or "data" not in mesh.shape:
+                raise ValueError(
+                    "data_shards > 1 requires a mesh with a 'data' axis to lay "
+                    "the shard axis over."
+                )
+            if int(mesh.shape["data"]) != self.data_shards:
+                raise ValueError(
+                    f"data_shards ({self.data_shards}) must equal the mesh's "
+                    f"'data' axis size ({int(mesh.shape['data'])}): the sharded "
+                    "layout places exactly one subject-pool per data-axis row."
+                )
+            self.arrays = self._build_and_upload_sharded()
         else:
-            self.arrays = {k: jnp.asarray(v) for k, v in host.items()}
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "replicated resident tables cannot span processes — on "
+                    f"{jax.process_count()} processes use the sharded layout "
+                    "(DeviceDataset.create picks data_shards from the mesh), "
+                    "or set trainer_config.device_resident_data='auto'/false."
+                )
+            host = self._build_dense_tables()
+            self.nbytes = sum(a.nbytes for a in host.values())
+            if mesh is not None:
+                replicated = NamedSharding(mesh, P())
+                self.arrays = {k: jax.device_put(v, replicated) for k, v in host.items()}
+            else:
+                self.arrays = {k: jnp.asarray(v) for k, v in host.items()}
         self._kernel_cache: dict = {}
 
     # Default HBM budget for auto-residency: conservative against a 16 GB
@@ -240,6 +307,56 @@ class DeviceDataset:
         static = 2 * 4 * dataset.max_n_static * max(dataset.data.n_subjects, 1)
         return n_rows * per_row + static + dataset.data.subject_event_offsets.nbytes
 
+    @staticmethod
+    def estimate_sharded_nbytes(dataset: JaxDataset, n_shards: int) -> int:
+        """Predicted GLOBAL footprint of the sharded layout, without building.
+
+        Not ``estimate_nbytes``: every shard pads to the largest pool (plus
+        its own 2L slice guard), so a skewed cohort — one subject holding
+        most events — can cost up to ``n_shards ×`` the unsharded estimate.
+        Raises ``ValueError`` when the cohort cannot shard ``n_shards`` ways.
+        """
+        bounds = dataset.subject_shards(n_shards)
+        ev = np.asarray(dataset.data.subject_event_offsets, np.int64)[bounds]
+        n_rows = int(np.diff(ev).max()) + 2 * dataset.max_seq_len
+        n_subj_rows = int(np.diff(bounds).max())
+        per_row = 4 + dataset.max_n_dynamic * (4 + 4 + 4 + 1)
+        static = 2 * 4 * dataset.max_n_static * n_subj_rows
+        return n_shards * (n_rows * per_row + static + (n_subj_rows + 1) * 4 + 8)
+
+    @classmethod
+    def create(
+        cls,
+        dataset: JaxDataset,
+        mesh: Mesh | None = None,
+        context_parallel: bool = False,
+    ) -> "DeviceDataset":
+        """Topology-aware constructor (no budget gate).
+
+        Single-process → the replicated layout. Multi-process → the sharded
+        layout over the mesh's ``data`` axis (one subject pool per data-axis
+        row; each process uploads only its addressable shards). Raises
+        ``ValueError`` with an actionable message on unsupported topologies
+        (no mesh / no ``data`` axis / fewer subjects than shards) instead of
+        silently misbehaving — this is the path explicit
+        ``device_resident_data: true`` configs take.
+        """
+        if jax.process_count() == 1:
+            return cls(dataset, mesh=mesh, context_parallel=context_parallel)
+        if mesh is None or "data" not in mesh.shape:
+            raise ValueError(
+                f"device-resident data on {jax.process_count()} processes "
+                "requires a device mesh with a 'data' axis (the dense tables "
+                "shard over it); this caller passed "
+                f"mesh={'None' if mesh is None else tuple(mesh.shape.items())}."
+            )
+        return cls(
+            dataset,
+            mesh=mesh,
+            context_parallel=context_parallel,
+            data_shards=int(mesh.shape["data"]),
+        )
+
     @classmethod
     def try_create(
         cls,
@@ -247,75 +364,207 @@ class DeviceDataset:
         mesh: Mesh | None = None,
         context_parallel: bool = False,
         max_bytes: int | None = None,
+        batch_sizes: tuple[int, ...] = (),
     ) -> "DeviceDataset | None":
         """`DeviceDataset` when residency is eligible, else ``None``.
 
-        The single auto-residency gate every harness shares: single-process
-        runs only, estimated tables within ``max_bytes`` (default
-        `DEFAULT_BUDGET_BYTES`), CSR arrays int32-narrow. Callers fall back
-        to host collation on ``None``.
+        The single auto-residency gate every harness shares: estimated tables
+        within ``max_bytes`` (default `DEFAULT_BUDGET_BYTES`), CSR arrays
+        int32-narrow, finite values. Multi-process topologies take the
+        sharded layout (each process uploads ~1/P of the tables, so the
+        budget applies to the per-process share) and additionally need a
+        mesh with a ``data`` axis, plus every batch size the caller will
+        stream (``batch_sizes``) divisible by the shard count — checked HERE
+        so an ineligible eval batch size falls back to host collation at
+        startup instead of killing the run at its first dealt stream.
+        Callers fall back to host collation on ``None``.
         """
-        if jax.process_count() != 1:
+        budget = max_bytes or cls.DEFAULT_BUDGET_BYTES
+        n_proc = jax.process_count()
+        if n_proc == 1:
+            if cls.estimate_nbytes(dataset) > budget:
+                return None
+            try:
+                return cls(dataset, mesh=mesh, context_parallel=context_parallel)
+            except ValueError:
+                return None
+        if mesh is None or "data" not in mesh.shape:
             return None
-        if cls.estimate_nbytes(dataset) > (max_bytes or cls.DEFAULT_BUDGET_BYTES):
+        if any(int(b) % int(mesh.shape["data"]) for b in batch_sizes):
             return None
         try:
-            return cls(dataset, mesh=mesh, context_parallel=context_parallel)
+            # The sharded estimate, not estimate_nbytes // K: shards pad to
+            # the largest pool, so skewed cohorts cost more than total/K —
+            # the budget must bound what a process will actually upload.
+            global_bytes = cls.estimate_sharded_nbytes(dataset, int(mesh.shape["data"]))
+            if global_bytes // n_proc > budget:
+                return None
+            return cls.create(dataset, mesh=mesh, context_parallel=context_parallel)
         except ValueError:
             return None
 
     def _build_dense_tables(self) -> dict:
         """CSR → dense per-event tables (see `_RESIDENT_FIELDS` for why)."""
+        return self._dense_tables_for_subjects(0, self.dataset.data.n_subjects)
+
+    def _dense_tables_for_subjects(
+        self,
+        s_lo: int,
+        s_hi: int,
+        n_rows_pad: int | None = None,
+        n_subj_pad: int | None = None,
+    ) -> dict:
+        """Dense tables for the subject range ``[s_lo, s_hi)``, with all
+        offsets LOCAL to the range (event row 0 = the range's first event).
+
+        The full-range call is the replicated layout; the sharded layout
+        builds one range per shard, padded (``n_rows_pad`` event rows,
+        ``n_subj_pad`` subject rows) so every shard stacks to one uniform
+        global array. Padding subject rows repeat the final offset (zero-
+        length subjects that dealing never references); padding event rows
+        are zeros, indistinguishable from the slice-guard pad.
+        """
         ds = self.dataset
         d = ds.data
         L = ds.max_seq_len
         M = ds.max_n_dynamic
-        n_events = len(d.time_delta)
+        ev_lo = int(d.subject_event_offsets[s_lo])
+        ev_hi = int(d.subject_event_offsets[s_hi])
+        n_events = ev_hi - ev_lo
+        n_rows = n_rows_pad if n_rows_pad is not None else n_events + 2 * L
 
-        off = np.asarray(d.event_data_offsets, np.int64)
+        off = np.asarray(d.event_data_offsets[ev_lo : ev_hi + 1], np.int64)
         counts = np.diff(off)
+        el_lo, el_hi = int(off[0]), int(off[-1])
         # Clip slots beyond M (possible when config.max_n_dynamic caps below
         # the data's true max — host collation drops them the same way).
-        slot = np.arange(off[-1], dtype=np.int64) - np.repeat(off[:-1], counts)
+        slot = np.arange(el_hi - el_lo, dtype=np.int64) - np.repeat(off[:-1] - el_lo, counts)
         keep = slot < M
         rows = np.repeat(np.arange(n_events), counts)[keep] + L
         cols = slot[keep]
 
         def dense(src, dtype):
-            t = np.zeros((n_events + 2 * L, M), dtype)
-            t[rows, cols] = np.asarray(src)[keep]
-            return t
+            return _dense_pre_sliced(src[el_lo:el_hi], rows, cols, keep, n_rows, M, dtype)
 
-        td = np.zeros(n_events + 2 * L, np.float32)
-        td[L : L + n_events] = d.time_delta
+        td = np.zeros(n_rows, np.float32)
+        td[L : L + n_events] = d.time_delta[ev_lo:ev_hi]
 
         S = ds.max_n_static
-        n_subjects = d.n_subjects
-        st_idx = np.zeros((max(n_subjects, 1), S), np.int32)
-        st_meas = np.zeros((max(n_subjects, 1), S), np.int32)
+        n_subjects = s_hi - s_lo
+        n_subj_rows = n_subj_pad if n_subj_pad is not None else max(n_subjects, 1)
+        st_idx = np.zeros((n_subj_rows, S), np.int32)
+        st_meas = np.zeros((n_subj_rows, S), np.int32)
         if ds.do_produce_static_data and n_subjects:
-            st_off = np.asarray(d.static_offsets, np.int64)
+            st_off = np.asarray(d.static_offsets[s_lo : s_hi + 1], np.int64)
             st_counts = np.diff(st_off)
-            st_slot = np.arange(st_off[-1], dtype=np.int64) - np.repeat(st_off[:-1], st_counts)
+            st_el_lo, st_el_hi = int(st_off[0]), int(st_off[-1])
+            st_slot = np.arange(st_el_hi - st_el_lo, dtype=np.int64) - np.repeat(
+                st_off[:-1] - st_el_lo, st_counts
+            )
             st_keep = st_slot < S
             st_rows = np.repeat(np.arange(n_subjects), st_counts)[st_keep]
-            st_idx[st_rows, st_slot[st_keep]] = np.asarray(d.static_indices)[st_keep]
-            st_meas[st_rows, st_slot[st_keep]] = np.asarray(d.static_measurement_indices)[
-                st_keep
-            ]
+            st_idx[st_rows, st_slot[st_keep]] = np.asarray(
+                d.static_indices[st_el_lo:st_el_hi]
+            )[st_keep]
+            st_meas[st_rows, st_slot[st_keep]] = np.asarray(
+                d.static_measurement_indices[st_el_lo:st_el_hi]
+            )[st_keep]
 
+        offsets = np.asarray(d.subject_event_offsets[s_lo : s_hi + 1], np.int64) - ev_lo
+        if n_subj_pad is not None and len(offsets) < n_subj_pad + 1:
+            offsets = np.concatenate(
+                [offsets, np.full(n_subj_pad + 1 - len(offsets), offsets[-1], np.int64)]
+            )
+
+        vals = np.where(
+            d.dynamic_values_observed[el_lo:el_hi], d.dynamic_values[el_lo:el_hi], 0.0
+        )
         return {
-            "subject_event_offsets": np.asarray(d.subject_event_offsets, np.int32),
+            "subject_event_offsets": offsets.astype(np.int32),
             "time_delta": td,
             "dynamic_indices": dense(d.dynamic_indices, np.int32),
             "dynamic_measurement_indices": dense(d.dynamic_measurement_indices, np.int32),
-            "dynamic_values": dense(
-                np.where(d.dynamic_values_observed, d.dynamic_values, 0.0), np.float32
-            ),
+            "dynamic_values": _dense_pre_sliced(vals, rows, cols, keep, n_rows, M, np.float32),
             "dynamic_values_obs": dense(d.dynamic_values_observed, bool),
             "static_indices": st_idx,
             "static_measurement_indices": st_meas,
         }
+
+    # ----------------------------------------------------- sharded layout
+    def _shard_layout(self) -> tuple[np.ndarray, int, int]:
+        """``(bounds, n_rows, n_subj_rows)`` for the stacked shard tables.
+
+        ``bounds`` are the subject-pool boundaries; every shard's event table
+        pads to ``n_rows`` (largest shard + the 2L slice guard) and its
+        subject axes to ``n_subj_rows`` so the stack is one uniform global
+        array.
+        """
+        ds = self.dataset
+        bounds = ds.subject_shards(self.data_shards)
+        ev = np.asarray(ds.data.subject_event_offsets, np.int64)[bounds]
+        n_rows = int(np.diff(ev).max()) + 2 * ds.max_seq_len
+        n_subj_rows = int(np.diff(bounds).max())
+        return bounds, n_rows, n_subj_rows
+
+    def _build_and_upload_sharded(self) -> dict:
+        """Stacked per-shard tables as global arrays sharded over ``data``.
+
+        Each process materializes ONLY the shards its addressable devices
+        hold (``jax.make_array_from_callback`` requests exactly those global
+        slices), which is what makes pod-scale residency per-host-bounded:
+        host RAM and HBM per process scale with its subject share, not the
+        cohort.
+        """
+        ds = self.dataset
+        K = self.data_shards
+        bounds, n_rows, n_subj_rows = self._shard_layout()
+        ev_base = np.asarray(ds.data.subject_event_offsets, np.int64)[bounds[:-1]]
+
+        shard_cache: dict[int, dict] = {}
+
+        def shard_tables(k: int) -> dict:
+            if k not in shard_cache:
+                shard_cache[k] = self._dense_tables_for_subjects(
+                    int(bounds[k]), int(bounds[k + 1]),
+                    n_rows_pad=n_rows, n_subj_pad=n_subj_rows,
+                )
+            return shard_cache[k]
+
+        M, S = ds.max_n_dynamic, ds.max_n_static
+        field_shapes: dict[str, tuple] = {
+            "subject_event_offsets": (K, n_subj_rows + 1),
+            "time_delta": (K, n_rows),
+            "dynamic_indices": (K, n_rows, M),
+            "dynamic_measurement_indices": (K, n_rows, M),
+            "dynamic_values": (K, n_rows, M),
+            "dynamic_values_obs": (K, n_rows, M),
+            "static_indices": (K, n_subj_rows, S),
+            "static_measurement_indices": (K, n_subj_rows, S),
+        }
+        bases = {
+            "shard_subject_base": bounds[:-1].astype(np.int32),
+            "shard_event_base": ev_base.astype(np.int32),
+        }
+
+        arrays: dict = {}
+        self.nbytes = 0
+        for name, shape in field_shapes.items():
+            sharding = NamedSharding(self.mesh, P("data", *([None] * (len(shape) - 1))))
+
+            def cb(index, name=name):
+                ks = range(*index[0].indices(K))
+                return np.stack([shard_tables(k)[name] for k in ks])
+
+            arrays[name] = jax.make_array_from_callback(shape, sharding, cb)
+            self.nbytes += int(np.prod(shape)) * arrays[name].dtype.itemsize
+        for name, host in bases.items():
+            sharding = NamedSharding(self.mesh, P("data"))
+            arrays[name] = jax.make_array_from_callback(
+                (K,), sharding, lambda index, host=host: host[index[0]]
+            )
+            self.nbytes += host.nbytes
+        shard_cache.clear()
+        return arrays
 
     # ----------------------------------------------------------- shardings
     # Fields whose dim 1 is the event (sequence) axis — sharded over the
@@ -360,9 +609,18 @@ class DeviceDataset:
 
     def padded_kernel(self):
         """The un-jitted padded collate kernel, bound to this dataset's
-        shapes — the single source of the config→kernel mapping."""
+        shapes — the single source of the config→kernel mapping.
+
+        Sharded layouts wrap the same per-shard kernel in a vmap over the
+        shard axis: plan indices (global, dealt shard-major) rebase to each
+        pool's local subject axis, every lane gathers ONLY its own table
+        shard (no cross-shard collectives under GSPMD — the batched gather's
+        leading axis matches the tables' ``data`` sharding), and the outputs
+        merge back to the plain ``(B, ...)`` global batch the train step
+        already consumes.
+        """
         ds = self.dataset
-        return partial(
+        base = partial(
             padded_collate_kernel,
             L=ds.max_seq_len,
             M=ds.max_n_dynamic,
@@ -370,14 +628,63 @@ class DeviceDataset:
             pad_right=ds.seq_padding_side == SeqPaddingSide.RIGHT,
             do_static=ds.do_produce_static_data,
         )
+        if self.data_shards == 1:
+            return base
+        K = self.data_shards
+
+        def sharded(arrays, subject_indices, starts, valid):
+            B = subject_indices.shape[0]
+            bl = B // K
+            tables = {k: arrays[k] for k in _RESIDENT_FIELDS}
+
+            def lane(tab, subj_base, si, st, va):
+                return base(tab, si - subj_base, st, va)
+
+            out = jax.vmap(lane)(
+                tables,
+                arrays["shard_subject_base"],
+                jnp.asarray(subject_indices).reshape(K, bl),
+                jnp.asarray(starts).reshape(K, bl),
+                jnp.asarray(valid).reshape(K, bl),
+            )
+            return {k: v.reshape((B,) + v.shape[2:]) for k, v in out.items()}
+
+        return sharded
 
     def packed_kernel(self):
-        """The un-jitted packed collate kernel bound to this dataset."""
-        return partial(
+        """The un-jitted packed collate kernel bound to this dataset.
+
+        Sharded layouts mirror `padded_kernel`: global event ids rebase to
+        each shard's local event axis (masked slots carry global id 0, which
+        goes negative after rebasing — clamped to 0 and zeroed by the mask,
+        exactly the host convention) and the row gathers stay shard-local.
+        """
+        base = partial(
             packed_collate_kernel,
             L_PAD=self.dataset.max_seq_len,
             M=self.dataset.max_n_dynamic,
         )
+        if self.data_shards == 1:
+            return base
+        K = self.data_shards
+
+        def sharded(arrays, event_ids, event_mask):
+            B, L = event_ids.shape
+            bl = B // K
+            tables = {k: arrays[k] for k in _RESIDENT_FIELDS}
+
+            def lane(tab, ev_base, eids, mask):
+                return base(tab, jnp.maximum(eids - ev_base, 0), mask)
+
+            out = jax.vmap(lane)(
+                tables,
+                arrays["shard_event_base"],
+                jnp.asarray(event_ids).reshape(K, bl, L),
+                jnp.asarray(event_mask).reshape(K, bl, L),
+            )
+            return {k: v.reshape((B,) + v.shape[2:]) for k, v in out.items()}
+
+        return sharded
 
     def _jit_kernel(self, key: tuple, kern) -> "jax.stages.Wrapped":
         if key not in self._kernel_cache:
@@ -472,6 +779,7 @@ class DeviceDataset:
             seed=seed,
             drop_last=drop_last,
             skip_batches=skip_batches,
+            n_shards=self.data_shards,
         ):
             b = self.collate(plan)
             yield (b, plan.n_events) if with_counts else b
@@ -493,10 +801,9 @@ class DeviceDataset:
         """
         ds = self.dataset
         L = seq_len or ds.max_seq_len
-        n = len(ds)
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(n) if shuffle else np.arange(n)
-        rows = ds._pack_rows(L, rng, order)
+        rows = ds.packed_rows_dealt(
+            batch_size, seq_len=L, shuffle=shuffle, seed=seed, n_shards=self.data_shards
+        )
 
         for lo_idx in range(0, len(rows), batch_size):
             chunk = rows[lo_idx : lo_idx + batch_size]
@@ -534,6 +841,7 @@ class DeviceDataset:
             seed=seed,
             drop_last=drop_last,
             skip_batches=skip_batches,
+            n_shards=self.data_shards,
         ):
             buf.append(plan)
             if len(buf) == chunk_steps:
@@ -570,10 +878,9 @@ class DeviceDataset:
         """
         ds = self.dataset
         L = seq_len or ds.max_seq_len
-        n = len(ds)
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(n) if shuffle else np.arange(n)
-        rows = ds._pack_rows(L, rng, order)
+        rows = ds.packed_rows_dealt(
+            batch_size, seq_len=L, shuffle=shuffle, seed=seed, n_shards=self.data_shards
+        )
 
         buf: list[tuple] = []
         n_ev_buf = 0

@@ -751,6 +751,62 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         return EventStreamBatch(**out)
 
     # -------------------------------------------------------------- batching
+    # ---------------------------------------------------------- shard pools
+    def subject_shards(self, n_shards: int) -> np.ndarray:
+        """Contiguous subject-pool boundaries for an ``n_shards``-way layout.
+
+        Returns ``(n_shards + 1,)`` indices into the subject axis; shard ``k``
+        owns subjects ``[bounds[k], bounds[k+1])``. Boundaries balance EVENT
+        counts (not subject counts): the device-resident sharded layout pads
+        every shard's dense event table to the largest shard, so balancing
+        events minimizes padding waste and balances per-process HBM.
+
+        The partition is a pure function of the dataset (no rng), so every
+        process computes the identical layout.
+        """
+        n = self.data.n_subjects
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        if n < n_shards:
+            raise ValueError(
+                f"cannot shard {n} subjects over {n_shards} shards; every shard "
+                "needs at least one subject (lower the shard count or use the "
+                "replicated layout)."
+            )
+        cum = np.asarray(self.data.subject_event_offsets, np.int64)
+        total = cum[-1]
+        targets = (np.arange(1, n_shards) * total) // n_shards
+        bounds = np.searchsorted(cum, targets, side="left").astype(np.int64)
+        bounds = np.concatenate([[0], bounds, [n]])
+        # Event-balanced split points can collide on skewed cohorts; force
+        # strictly increasing boundaries so every shard is non-empty.
+        for k in range(1, n_shards + 1):
+            bounds[k] = min(max(bounds[k], bounds[k - 1] + 1), n - (n_shards - k))
+        return bounds
+
+    def _shard_orders(
+        self, n_shards: int, rng: np.random.Generator, shuffle: bool
+    ) -> list[np.ndarray]:
+        """Per-shard subject orders, drawn shard-by-shard from ONE rng stream.
+
+        With ``n_shards == 1`` this consumes the rng exactly like the
+        historical single-stream path (one ``rng.permutation(n)``), so the
+        degenerate case reproduces the existing epoch streams bit-for-bit.
+        """
+        if n_shards == 1:
+            n = self.data.n_subjects
+            return [rng.permutation(n) if shuffle else np.arange(n)]
+        bounds = self.subject_shards(n_shards)
+        return [
+            bounds[k]
+            + (
+                rng.permutation(bounds[k + 1] - bounds[k])
+                if shuffle
+                else np.arange(bounds[k + 1] - bounds[k])
+            )
+            for k in range(n_shards)
+        ]
+
     # ------------------------------------------------------------- packing
     def _pack_rows(self, L: int, rng: np.random.Generator, order: np.ndarray):
         """First-fit packs subject (sub)sequences into rows of ``L`` events.
@@ -803,6 +859,47 @@ class JaxDataset(SeedableMixin, TimeableMixin):
                 open_rows = open_rows[-MAX_OPEN_ROWS:]
         return rows
 
+    def packed_rows_dealt(
+        self,
+        batch_size: int,
+        seq_len: int | None = None,
+        shuffle: bool = True,
+        seed: int | None = None,
+        n_shards: int = 1,
+    ) -> list:
+        """The epoch's packed rows in batch order, optionally dealt per shard.
+
+        ``n_shards == 1``: exactly the historical stream — one permutation,
+        one `_pack_rows` pass (the trailing short batch, if any, is left for
+        callers to keep or drop). ``n_shards > 1``: each shard's subject pool
+        is packed separately (rows reference one pool only, so the sharded
+        device tables can gather locally), rows are dealt shard-major with
+        ``batch_size / n_shards`` rows per shard per batch, and only full
+        batches survive (the per-shard row counts differ, so the stream stops
+        at the shortest shard). All randomness comes from one shared rng
+        stream, consumed shard-by-shard — every process derives the same
+        rows.
+        """
+        L = seq_len or self.max_seq_len
+        rng = np.random.default_rng(seed)
+        if n_shards == 1:
+            n = len(self)
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            return self._pack_rows(L, rng, order)
+        if batch_size % n_shards != 0:
+            raise ValueError(
+                f"batch_size ({batch_size}) must be divisible by n_shards ({n_shards})."
+            )
+        b_local = batch_size // n_shards
+        orders = self._shard_orders(n_shards, rng, shuffle)
+        rows_by_shard = [self._pack_rows(L, rng, order) for order in orders]
+        n_batches = min(len(r) // b_local for r in rows_by_shard)
+        rows: list = []
+        for i in range(n_batches):
+            for shard_rows in rows_by_shard:
+                rows.extend(shard_rows[i * b_local : (i + 1) * b_local])
+        return rows
+
     def packed_row_plan(
         self, rows_chunk: list, L: int
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
@@ -842,6 +939,7 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         seq_len: int | None = None,
         shuffle: bool = True,
         seed: int | None = None,
+        n_shards: int = 1,
     ) -> int:
         """Number of **full** batches `packed_batches` will yield.
 
@@ -850,10 +948,10 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         (packing several subjects per row makes the per-epoch batch count a
         packing-factor smaller than the padded count).
         """
-        L = seq_len or self.max_seq_len
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(len(self)) if shuffle else np.arange(len(self))
-        return len(self._pack_rows(L, rng, order)) // batch_size
+        rows = self.packed_rows_dealt(
+            batch_size, seq_len=seq_len, shuffle=shuffle, seed=seed, n_shards=n_shards
+        )
+        return len(rows) // batch_size
 
     def packed_batches(
         self,
@@ -861,6 +959,7 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         seq_len: int | None = None,
         shuffle: bool = True,
         seed: int | None = None,
+        n_shards: int = 1,
     ):
         """Yields packed long-context batches with per-event ``segment_ids``.
 
@@ -881,10 +980,9 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         L = seq_len or self.max_seq_len
         M = self.max_n_dynamic
         d = self.data
-        n = len(self)
-        rng = np.random.default_rng(seed)
-        order = rng.permutation(n) if shuffle else np.arange(n)
-        rows = self._pack_rows(L, rng, order)
+        rows = self.packed_rows_dealt(
+            batch_size, seq_len=L, shuffle=shuffle, seed=seed, n_shards=n_shards
+        )
 
         for lo_idx in range(0, len(rows), batch_size):
             chunk = rows[lo_idx : lo_idx + batch_size]
@@ -923,6 +1021,7 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         seed: int | None = None,
         drop_last: bool | None = None,
         skip_batches: int = 0,
+        n_shards: int = 1,
     ):
         """Yields `EventStreamBatch`es of exactly ``batch_size`` subjects.
 
@@ -939,19 +1038,30 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         collating them (mid-epoch resume after preemption): the rng stream is
         advanced identically, so batch N+1 onward is bitwise-identical to an
         uninterrupted epoch.
+
+        ``n_shards`` selects the dealt (sharded) plan stream — see
+        `plan_batches`. Host collation handles dealt plans transparently
+        (indices are global either way), which is what the multi-process
+        parity tests lean on.
         """
         for plan in self.plan_batches(
-            batch_size, shuffle=shuffle, seed=seed, drop_last=drop_last, skip_batches=skip_batches
+            batch_size,
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+            skip_batches=skip_batches,
+            n_shards=n_shards,
         ):
             b = self._collate_with_starts(
                 plan.subject_indices, plan.starts, plan.kept, start_time=plan.start_time
             )
-            n_real = int(plan.valid_mask.sum())
-            if n_real < batch_size:
+            if not plan.valid_mask.all():
+                # Blank fill rows wherever they sit (a dealt stream can have
+                # them mid-batch, one run per exhausted shard).
                 event_mask = np.asarray(b.event_mask).copy()
-                event_mask[n_real:] = False
+                event_mask[~plan.valid_mask] = False
                 values_mask = np.asarray(b.dynamic_values_mask).copy()
-                values_mask[n_real:] = False
+                values_mask[~plan.valid_mask] = False
                 b = b.replace(
                     event_mask=event_mask, dynamic_values_mask=values_mask,
                     valid_mask=plan.valid_mask,
@@ -967,6 +1077,7 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         seed: int | None = None,
         drop_last: bool | None = None,
         skip_batches: int = 0,
+        n_shards: int = 1,
     ):
         """Yields `BatchPlan`s — the ~100-byte rng-dependent part of a batch.
 
@@ -978,21 +1089,47 @@ class JaxDataset(SeedableMixin, TimeableMixin):
         the plan instead of the ~MB batch. Both consume the identical rng
         stream via `_draw_starts`, so device- and host-collated epochs are
         bit-identical and ``skip_batches`` resume semantics are shared.
+
+        ``n_shards > 1`` selects the DEALT stream for the sharded
+        device-resident layout (multi-host pods): subjects are partitioned
+        into ``n_shards`` contiguous pools (`subject_shards`), each batch
+        takes ``batch_size / n_shards`` rows from every pool in shard-major
+        row order, and all randomness (per-pool permutations, then crop
+        starts per batch) is drawn from the SAME single rng stream on every
+        process — so all processes derive identical plans and each data-axis
+        shard's rows reference only subjects resident in its own table
+        shard. ``n_shards=1`` reproduces the historical global stream
+        bit-for-bit. Plans always carry GLOBAL subject indices; the sharded
+        collate kernel rebases them on device.
         """
-        n = len(self)
+        if batch_size % n_shards != 0:
+            raise ValueError(
+                f"batch_size ({batch_size}) must be divisible by n_shards "
+                f"({n_shards}) to deal equal per-shard rows."
+            )
+        b_local = batch_size // n_shards
         if drop_last is None:
             drop_last = shuffle
         rng = np.random.default_rng(seed)
-        order = rng.permutation(n) if shuffle else np.arange(n)
-        stop = n - (n % batch_size) if drop_last else n
-        for i, lo in enumerate(range(0, stop, batch_size)):
-            idx = order[lo : lo + batch_size]
-            n_real = len(idx)
-            if n_real < batch_size:
-                # np.resize repeats cyclically, so this stays full even when
-                # batch_size exceeds the dataset size.
-                fill = np.resize(order, batch_size - n_real)
-                idx = np.concatenate([idx, fill])
+        orders = self._shard_orders(n_shards, rng, shuffle)
+        if drop_last:
+            n_batches = min(len(o) // b_local for o in orders)
+        else:
+            n_batches = max(-(-len(o) // b_local) for o in orders)
+        for i in range(n_batches):
+            lo = i * b_local
+            parts, valid_parts = [], []
+            for order in orders:
+                idx_k = order[lo : lo + b_local]
+                n_real_k = len(idx_k)
+                if n_real_k < b_local:
+                    # np.resize repeats cyclically, so this stays full even
+                    # when the pool is smaller than its per-batch share.
+                    idx_k = np.concatenate([idx_k, np.resize(order, b_local - n_real_k)])
+                parts.append(idx_k)
+                valid_parts.append(np.arange(b_local) < n_real_k)
+            idx = np.concatenate(parts)
+            valid_mask = np.concatenate(valid_parts)
             starts, kept = self._draw_starts(idx, rng)
             if i < skip_batches:
                 continue
@@ -1008,7 +1145,7 @@ class JaxDataset(SeedableMixin, TimeableMixin):
                 subject_indices=np.asarray(idx, dtype=np.int32),
                 starts=starts.astype(np.int32),
                 kept=kept.astype(np.int32),
-                valid_mask=np.arange(batch_size) < n_real,
-                n_events=int(kept[:n_real].sum()),
+                valid_mask=valid_mask,
+                n_events=int(kept[valid_mask].sum()),
                 start_time=start_time,
             )

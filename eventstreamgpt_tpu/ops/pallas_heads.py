@@ -42,6 +42,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams → CompilerParams; accept both so the kernels
+# (and their interpret-mode tests) run on every jaxlib the fleet carries.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 __all__ = ["vocab_gather"]
 
 LANE = 128
@@ -124,7 +128,7 @@ def _gather_2d(z: jnp.ndarray, ci: jnp.ndarray, interpret: bool = False) -> jnp.
         ],
         out_specs=pl.BlockSpec((_ROW_TILE, mp), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((rows, mp), jnp.float32),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
         interpret=interpret,
     )(z, ci.astype(jnp.int32))
     return out[:n, :m]
@@ -148,7 +152,7 @@ def _scatter_2d(
         ],
         out_specs=pl.BlockSpec((_ROW_TILE, vp), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((rows, vp), dtype),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
         interpret=interpret,
     )(g.astype(jnp.float32), ci.astype(jnp.int32))
     return dz[:n, :v]

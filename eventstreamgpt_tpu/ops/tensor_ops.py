@@ -151,7 +151,11 @@ def _weighted_multihot(indices: jnp.ndarray, weights: jnp.ndarray, vocab: int) -
     small M axis keeps peak memory at one ``(N, vocab)`` plane)."""
     # jnp arrays up front: the loop body indexes with a traced counter, which
     # host numpy inputs (eager callers) cannot do.
-    indices = jnp.asarray(indices)
+    # Clip to the table range: the forward gathers with mode="clip", so an
+    # out-of-range index reads the edge row and its cotangent must credit
+    # that same row — an unclipped equality match would silently drop it
+    # (the XLA scatter backward credits the clipped row; parity is tested).
+    indices = jnp.clip(jnp.asarray(indices), 0, vocab - 1)
     weights = jnp.asarray(weights)
     iota = jnp.arange(vocab, dtype=indices.dtype)[None, :]
     n = indices.shape[0]

@@ -110,7 +110,15 @@ def get_embeddings(cfg: FinetuneConfig) -> dict[str, Path]:
         # redesign: no per-batch wire transfer); host prefetch otherwise.
         # valid_mask is a host array either way, so reading it costs no
         # device sync.
-        dd = DeviceDataset.try_create(dataset, mesh=mesh)
+        dd = DeviceDataset.try_create(
+            dataset, mesh=mesh, batch_sizes=(oc.validation_batch_size,)
+        )
+        if dd is not None and dd.data_shards > 1:
+            # The dealt sharded stream interleaves subject pools, but the
+            # saved .npy contract is dataset row order; extraction is a
+            # one-shot job, so take the ordered host path (the multi-process
+            # status quo) instead of reordering device output.
+            dd = None
         if dd is not None:
             batch_iter = (
                 (b, np.asarray(b.valid_mask) if b.valid_mask is not None else None)

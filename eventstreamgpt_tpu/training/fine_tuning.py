@@ -106,15 +106,22 @@ class StreamClassificationMetrics:
         else:
             raise ValueError(f"{problem} not valid")
 
-    def update(self, out, n_valid: int | None = None, skip_metrics=()) -> None:
+    def update(
+        self, out, n_valid: int | None = None, valid_mask=None, skip_metrics=()
+    ) -> None:
         preds = np.asarray(out.preds)
         labels = np.asarray(out.labels)
         B = len(labels)
-        if n_valid is None:
-            n_valid = B
-        # Fill rows (beyond n_valid) are blanked subjects — drop them.
-        preds, labels = preds[:n_valid], labels[:n_valid]
-        self.loss.update(float(out.loss), weight=n_valid)
+        # Fill rows are blanked subjects — drop them. The dealt (sharded)
+        # plan stream can leave fill rows MID-batch (one run per exhausted
+        # pool), so a boolean mask is authoritative; ``n_valid`` keeps the
+        # historical trailing-fill prefix convention for callers without one.
+        if valid_mask is None:
+            valid_mask = np.arange(B) < (B if n_valid is None else n_valid)
+        else:
+            valid_mask = np.asarray(valid_mask, bool)
+        preds, labels = preds[valid_mask], labels[valid_mask]
+        self.loss.update(float(out.loss), weight=int(valid_mask.sum()))
         for name, metric in self.metrics.items():
             if any(s in name for s in skip_metrics):
                 continue
@@ -342,33 +349,42 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     # fine-tuning cohorts essentially always fit the budget.
     from ..data.device_dataset import DeviceDataset
 
-    device_train = DeviceDataset.try_create(train_pyd, mesh=mesh)
+    device_train = DeviceDataset.try_create(
+        train_pyd, mesh=mesh, batch_sizes=(oc.batch_size, oc.validation_batch_size)
+    )
     _device_eval_cache: dict[int, "DeviceDataset | None"] = {}
 
     def evaluate(params, dataset, split) -> dict[str, float]:
         metrics = StreamClassificationMetrics(config, split)
         # seed=0 pins random subsequence crops: eval passes must be comparable.
         if id(dataset) not in _device_eval_cache:
-            _device_eval_cache[id(dataset)] = DeviceDataset.try_create(dataset, mesh=mesh)
+            _device_eval_cache[id(dataset)] = DeviceDataset.try_create(
+                dataset, mesh=mesh, batch_sizes=(oc.validation_batch_size,)
+            )
         dd = _device_eval_cache[id(dataset)]
         if dd is not None:
             for batch in dd.batches(
                 oc.validation_batch_size, shuffle=False, drop_last=False, seed=0
             ):
                 out = eval_step(params, batch)
-                metrics.update(out, n_valid=int(np.asarray(batch.valid_mask).sum()))
+                metrics.update(
+                    out,
+                    valid_mask=(
+                        np.asarray(batch.valid_mask) if batch.valid_mask is not None else None
+                    ),
+                )
             return metrics.compute()
         batch_iter = prefetch_to_device(
             dataset.batches(oc.validation_batch_size, shuffle=False, drop_last=False, seed=0),
             lambda b: shard_batch(b, mesh),
             host_stats_fn=lambda b: (
-                int(np.asarray(b.valid_mask).sum()) if b.valid_mask is not None else None
+                np.asarray(b.valid_mask) if b.valid_mask is not None else None
             ),
         )
         try:
-            for batch, n_valid in batch_iter:
+            for batch, valid_mask in batch_iter:
                 out = eval_step(params, batch)
-                metrics.update(out, n_valid=n_valid)
+                metrics.update(out, valid_mask=valid_mask)
         finally:
             batch_iter.close()
         return metrics.compute()

@@ -300,6 +300,21 @@ def make_chunked_train_step(model, tx, device_data, packed: bool = False) -> Cal
     return jax.jit(chunk_step, donate_argnums=(0,))
 
 
+def _plan_event_count(plans: dict, dataset: JaxDataset) -> int:
+    """Exact real-event count of a (possibly sliced) stacked plan chunk.
+
+    Used when ``max_training_steps`` truncates a chunk: the chunk-level count
+    from ``plan_chunks`` includes the dropped plans' events, which would
+    inflate the final logging window's events_per_sec.
+    """
+    if "event_mask" in plans:  # packed plans carry the mask directly
+        return int(np.asarray(plans["event_mask"]).sum())
+    off = np.asarray(dataset.data.subject_event_offsets, np.int64)
+    idx = np.asarray(plans["subject_indices"], np.int64)
+    kept = np.minimum(off[idx + 1] - off[idx], dataset.max_seq_len)
+    return int(kept[np.asarray(plans["valid_mask"])].sum())
+
+
 def make_eval_step(model) -> Callable:
     def eval_step(params, batch: EventStreamBatch):
         return model.apply(params, batch)
@@ -620,24 +635,32 @@ def train(
 
     # Device-resident data (round-5 feed-path redesign; data/device_dataset.py):
     # keep the dataset in HBM and run k on-device-collate + train steps per
-    # dispatch. 'auto' enables it for single-process runs whose dense tables
-    # fit a conservative HBM budget; numerics are bit-identical to the host
-    # path (tested), so this is purely a throughput decision.
+    # dispatch. 'auto' enables it when the tables fit a conservative HBM
+    # budget: single-process runs use the replicated layout, multi-process
+    # runs the sharded layout (each process uploads its subject-pool shard
+    # over the mesh's data axis and the plan stream is dealt shard-major —
+    # see DeviceDataset.create). Numerics are bit-identical to host collation
+    # of the same plan stream (tested), so this is purely a throughput
+    # decision.
     resident_mode = tc.get("device_resident_data", "auto")
     resident_budget = int(
         tc.get("device_resident_max_bytes") or DeviceDataset.DEFAULT_BUDGET_BYTES
     )
     device_train = device_tuning = None
     if resident_mode is True:
-        device_train = DeviceDataset(train_pyd, mesh=mesh, context_parallel=n_cp > 1)
-        device_tuning = DeviceDataset(tuning_pyd, mesh=mesh, context_parallel=n_cp > 1)
+        # Explicit opt-in: unsupported topologies raise a clear error here
+        # instead of silently entering an untested layout.
+        device_train = DeviceDataset.create(train_pyd, mesh=mesh, context_parallel=n_cp > 1)
+        device_tuning = DeviceDataset.create(tuning_pyd, mesh=mesh, context_parallel=n_cp > 1)
     elif resident_mode == "auto":
         device_train = DeviceDataset.try_create(
-            train_pyd, mesh=mesh, context_parallel=n_cp > 1, max_bytes=resident_budget
+            train_pyd, mesh=mesh, context_parallel=n_cp > 1, max_bytes=resident_budget,
+            batch_sizes=(oc.batch_size, oc.validation_batch_size),
         )
         if device_train is not None:
             device_tuning = DeviceDataset.try_create(
-                tuning_pyd, mesh=mesh, context_parallel=n_cp > 1, max_bytes=resident_budget
+                tuning_pyd, mesh=mesh, context_parallel=n_cp > 1, max_bytes=resident_budget,
+                batch_sizes=(oc.validation_batch_size,),
             )
     chunk_steps = tc.get("steps_per_execution") or "auto"
     if chunk_steps == "auto":
@@ -770,6 +793,9 @@ def train(
                         if remaining < k:
                             plans = {key_: v[:remaining] for key_, v in plans.items()}
                             k = remaining
+                            # Recount from the kept plans only — the chunk's
+                            # n_events includes the dropped plans' events.
+                            n_events = _plan_event_count(plans, train_pyd) if k > 0 else 0
                     if k <= 0:
                         break
                     # Profile the dispatch(es) overlapping steps [10, 20),
@@ -896,7 +922,8 @@ def train(
     held_out_pyd = JaxDataset(cfg.data_config, split="held_out")
     device_held_out = (
         DeviceDataset.try_create(
-            held_out_pyd, mesh=mesh, context_parallel=n_cp > 1, max_bytes=resident_budget
+            held_out_pyd, mesh=mesh, context_parallel=n_cp > 1, max_bytes=resident_budget,
+            batch_sizes=(oc.validation_batch_size,),
         )
         if device_train is not None
         else None

@@ -169,8 +169,15 @@ def zero_shot_evaluation(
         # Oversized cohorts fall back to host collation in a prefetch thread.
         # No mesh here: the data mesh is sized for the num_samples-expanded
         # batch, which generate() itself expands and shards; prompts collate
-        # unsharded.
+        # unsharded. Multi-process runs therefore also take the host fallback
+        # (the shared gate returns None without a 'data'-axis mesh to shard
+        # the tables over); prompt collation is a trivial fraction of the
+        # generation-bound workload, so residency is not worth a second mesh.
         device_ds = DeviceDataset.try_create(dataset)
+        # NaN-cleanliness of resident prompts is guaranteed at table-build
+        # time (DeviceDataset validates time_delta/dynamic_values finiteness
+        # once, host-side), so skipping the per-batch device readback below
+        # loses no safety.
         if device_ds is not None:
             batch_iter = (
                 (b, None)

@@ -134,6 +134,47 @@ class TestTensorOps:
             np.testing.assert_allclose(np.asarray(gt), np.asarray(rt), rtol=1e-4, atol=1e-5)
             np.testing.assert_allclose(np.asarray(gw_out), np.asarray(rw), rtol=1e-4, atol=1e-5)
 
+    def test_embedding_bag_backward_clips_out_of_range_like_scatter(self, monkeypatch):
+        """Out-of-range indices: the forward gathers with ``mode="clip"`` (the
+        edge row), so the matmul backward must credit that same edge row —
+        exactly what XLA's scatter backward of the clipped gather does. An
+        unclipped equality-match multihot would silently DROP the cotangent."""
+        import jax
+
+        from eventstreamgpt_tpu.ops import tensor_ops
+        from eventstreamgpt_tpu.ops.tensor_ops import grouped_embedding_bag
+
+        monkeypatch.setattr(tensor_ops, "_BAG_MATMUL_BWD_MIN_DIM", 1)
+
+        n_emb, dim, B, M, G = 12, 4, 3, 5, 2
+        table = jnp.asarray(RNG.normal(size=(n_emb, dim)).astype(np.float32))
+        indices = jnp.asarray(RNG.integers(1, n_emb, size=(B, M)))
+        # Poison slots with indices past the table end (the slot-clipping
+        # path can produce these when config caps slots below the data max).
+        indices = indices.at[0, 0].set(n_emb).at[2, 3].set(n_emb + 7)
+        weights = jnp.asarray(RNG.normal(size=(B, M)).astype(np.float32))
+        gw = jnp.asarray(RNG.normal(size=(B, G, M)).astype(np.float32))
+
+        def ref_bag(t, w):
+            gathered = jnp.take(t, indices, axis=0, mode="clip")
+            pm = (indices != 0).astype(t.dtype)
+            return jnp.einsum("...md,...m->...d", gathered, w * pm)
+
+        def ref_grouped(t, w):
+            gathered = jnp.take(t, indices, axis=0, mode="clip")
+            pm = (indices != 0).astype(t.dtype)
+            return jnp.einsum("...md,...gm->...gd", gathered, w * pm[..., None, :])
+
+        for fn, ref, w in (
+            (lambda t, w: embedding_bag(t, indices, w), ref_bag, weights),
+            (lambda t, w: grouped_embedding_bag(t, indices, w), ref_grouped, gw),
+        ):
+            gt = jax.grad(lambda t: (fn(t, w) ** 2).sum())(table)
+            rt = jax.grad(lambda t: (ref(t, w) ** 2).sum())(table)
+            # The edge row must actually receive credit for the clipped slots.
+            assert np.abs(np.asarray(rt[-1])).sum() > 0
+            np.testing.assert_allclose(np.asarray(gt), np.asarray(rt), rtol=1e-4, atol=1e-5)
+
     def test_measurement_index_normalization(self):
         mi = jnp.asarray([[1, 2, 5, 2, 2], [1, 3, 5, 3, 0]])
         out = measurement_index_normalization(mi)
