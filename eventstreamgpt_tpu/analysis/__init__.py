@@ -1,0 +1,42 @@
+"""graftcheck: JAX-aware static analysis for the TPU-native ESGPT stack.
+
+Three tiers, one CLI (``scripts/graftcheck.py``):
+
+* Tier A — ``lint``: custom AST rules (GC001-GC005) over the package for the
+  TPU footguns runtime tests only catch after they've burned a pod-hour:
+  host syncs reachable from traced scopes or jitted-dispatch loops, f64
+  dtype creep, PRNG key reuse, Python control flow on traced values, and
+  undonated train-step jits.
+* Tier B — ``program_checks``: AOT-lower the canonical pretrain / fine-tune /
+  generation step programs and assert static facts of the lowered module:
+  no f64 element types, no host transfers, collective payload bytes within
+  tolerance of the committed ``COLLECTIVES.json`` budget.
+* ``compile_guard``: a recompilation sentinel (context manager over the jit
+  trace caches / ``jax.monitoring`` compile events) used by tests and by
+  ``training/pretrain.py`` to fail fast if the step recompiles mid-epoch.
+
+``lint`` is pure stdlib (no jax import) so Tier A runs anywhere in
+milliseconds; the jax-importing tiers are deferred to submodule imports.
+"""
+
+from .lint import (  # noqa: F401
+    Finding,
+    RULES,
+    apply_baseline,
+    default_targets,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    save_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "apply_baseline",
+    "default_targets",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "save_baseline",
+]

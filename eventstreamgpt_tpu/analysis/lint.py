@@ -1,0 +1,1002 @@
+"""Tier A of graftcheck: JAX-aware AST lint rules (GC001-GC005).
+
+Pure stdlib — no jax import — so the whole package lints in well under a
+second. The rules encode the TPU footguns that runtime tests only catch
+after they've burned real accelerator time:
+
+* **GC001** host-sync calls (``.item()``, ``float()``, ``np.asarray``,
+  ``jax.device_get``, ``.block_until_ready()``) reachable from traced scopes
+  (jit/scan/vmap bodies) or lexically inside a loop that dispatches a known
+  jitted callable (the epoch hot loop). Inside a trace these are a
+  ``ConcretizationTypeError`` waiting to happen or a silent callback; inside
+  the dispatch loop they stall the pipeline on a device round trip per step.
+* **GC002** float64 dtype creep outside the host-side preprocessing
+  allowlist. TPUs emulate f64 at a many-fold slowdown; one stray
+  ``np.float64`` in a traced constant silently doubles a table's HBM.
+* **GC003** PRNG key reuse: a key variable consumed twice (or consumed in a
+  loop without an intervening ``split``/``fold_in`` reassignment) produces
+  correlated randomness — the classic silent-statistics bug.
+* **GC004** Python ``if``/``while`` on traced values in traced scopes:
+  either a tracer-boolean error at runtime or, with shape-dependent values,
+  a recompile per distinct value.
+* **GC005** a train-step ``jax.jit`` without ``donate_argnums``: the
+  optimizer state is double-buffered and peak HBM nearly doubles.
+
+Scope analysis is intentionally heuristic (module-local call graph +
+lexical nesting + simple local-variable dataflow); precision comes from the
+checked-in baseline (``analysis/baseline.json`` suppresses pre-existing
+findings while new ones fail) and inline waivers::
+
+    x = float(loss)  # graftcheck: allow GC001 -- epoch-end flush, pipeline already drained
+
+See ``docs/analysis.md`` for the rule catalog and fix patterns.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "lint_source",
+    "lint_paths",
+    "default_targets",
+    "load_baseline",
+    "save_baseline",
+    "apply_baseline",
+]
+
+RULES: dict[str, str] = {
+    "GC001": "host-sync call reachable from a traced scope or jitted-dispatch loop",
+    "GC002": "float64 dtype outside the host-side preprocessing allowlist",
+    "GC003": "PRNG key consumed twice without an intervening split/fold_in",
+    "GC004": "Python if/while on a traced value inside a traced scope",
+    "GC005": "train-step jax.jit without donate_argnums",
+}
+
+# Paths where f64 is the *point* (pandas/preprocessing fit statistics run
+# host-side at full precision; synthetic data generation is host-only).
+F64_ALLOWLIST_DIRS = ("data/preprocessing/",)
+F64_ALLOWLIST_FILES = ("dataset_pandas.py", "synthetic.py")
+
+# jax transforms whose function arguments execute under a trace.
+_TRACING_TRANSFORMS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint", "remat",
+    "custom_vjp", "custom_jvp", "named_call",
+    "scan", "while_loop", "cond", "switch", "map", "fori_loop",
+    "associative_scan",
+}
+_JIT_NAMES = {"jit"}  # jax.jit / nn.jit / plain jit
+
+_SYNC_ATTR_METHODS = {
+    "item": "`.item()` blocks on a device->host readback",
+    "block_until_ready": "`.block_until_ready()` blocks the host on the device stream",
+}
+_SYNC_DOTTED = {
+    "np.asarray": "`np.asarray` on a device array forces a host transfer",
+    "np.array": "`np.array` on a device array forces a host transfer",
+    "numpy.asarray": "`numpy.asarray` on a device array forces a host transfer",
+    "numpy.array": "`numpy.array` on a device array forces a host transfer",
+    "jax.device_get": "`jax.device_get` is an explicit device->host transfer",
+    "jax.block_until_ready": "`jax.block_until_ready` blocks the host on the device stream",
+}
+_SYNC_BUILTINS = {
+    "float": "`float()` on a device value blocks on a host readback",
+}
+
+# Attribute accesses that yield static (trace-time) metadata, not values.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding", "weak_type"}
+_STATIC_BUILTIN_CALLS = {"len", "isinstance", "getattr", "hasattr", "type", "id", "callable"}
+
+_KEY_PRODUCERS = {"PRNGKey", "key", "split", "fold_in", "clone", "wrap_key_data"}
+_KEY_PARAM_RE = re.compile(r"(^|_)(rng|key|prng_key)s?$")
+
+_ALLOW_RE = re.compile(r"graftcheck:\s*allow\s*(?P<rules>GC\d{3}(?:\s*,\s*GC\d{3})*)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, keyed for baselining by (path, rule, snippet)."""
+
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    rule: str
+    message: str
+    hint: str
+    snippet: str  # stripped source line, the line-number-stable baseline key
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.path, self.rule, self.snippet)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}\n    fix: {self.hint}"
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``jax.lax.scan`` -> "jax.lax.scan" for Name/Attribute chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _tail(dotted: str | None) -> str | None:
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+class _Func:
+    """A function scope: AST node + lexical parent + analysis state."""
+
+    def __init__(self, node, name: str, parent: "_Func | None"):
+        self.node = node
+        self.name = name
+        self.parent = parent
+        self.children: list[_Func] = []  # lexically nested defs
+        self.traced = False
+        self.returned_funcs: list[_Func] = []  # nested defs this factory returns
+        self.returns_jitted = False  # returns jax.jit(...) directly
+        # local name -> _Func whose returned_funcs the value aliases
+        self.factory_vars: dict[str, "_Func"] = {}
+        # local names bound to jitted callables (jax.jit(...) results or
+        # calls of factories that return one)
+        self.jitted_vars: set[str] = set()
+        self.call_targets: list["_Func"] = []  # resolved same-module callees
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return f"_Func({self.name}, traced={self.traced})"
+
+
+def _own_walk(func_node: ast.AST):
+    """Walks a function's *own* statements, not nested function bodies."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _walk_shallow(root: ast.AST):
+    """Walks a subtree (root included) without descending into nested
+    function bodies — a callback defined inside a loop only executes if
+    called, and calls are what the loop scan follows."""
+    yield root
+    yield from _own_walk(root)
+
+
+class _Module:
+    """Module-level index: function scopes, traced-set, jitted locals."""
+
+    def __init__(self, tree: ast.Module, path: str, src_lines: list[str]):
+        self.tree = tree
+        self.path = path
+        self.src_lines = src_lines
+        self.funcs: list[_Func] = []
+        self.by_node: dict[ast.AST, _Func] = {}
+        self.module_jitted: set[str] = set()
+        self._index(tree, parent=None)
+        for f in self.funcs:
+            self._analyze_locals(f)
+        self._mark_traced()
+
+    # ---------------------------------------------------------------- index
+    def _index(self, node: ast.AST, parent: _Func | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                name = getattr(child, "name", "<lambda>")
+                f = _Func(child, name, parent)
+                self.funcs.append(f)
+                self.by_node[child] = f
+                if parent is not None:
+                    parent.children.append(f)
+                self._index(child, f)
+            elif isinstance(child, ast.ClassDef):
+                # methods belong to no enclosing function scope
+                self._index(child, None)
+            else:
+                self._index(child, parent)
+
+    def resolve(self, scope: _Func | None, name: str) -> _Func | None:
+        """Lexical lookup of ``name`` among nested/module-level defs."""
+        f = scope
+        while f is not None:
+            for c in f.children:
+                if c.name == name:
+                    return c
+            if f.name == name:
+                return f
+            f = f.parent
+        for c in self.funcs:
+            if c.parent is None and c.name == name:
+                return c
+        return None
+
+    # --------------------------------------------------- local var dataflow
+    def _is_jit_call(self, call: ast.Call) -> bool:
+        return _tail(_dotted(call.func)) in _JIT_NAMES
+
+    def _factory_for_value(self, scope: _Func, value: ast.AST) -> _Func | None:
+        """The _Func whose returned functions ``value`` evaluates to."""
+        if isinstance(value, ast.Call):
+            g = None
+            if isinstance(value.func, ast.Name):
+                g = self.resolve(scope, value.func.id)
+            if g is not None and g.returned_funcs:
+                return g
+        return None
+
+    def _value_is_jitted(self, scope: _Func | None, value: ast.AST) -> bool:
+        if isinstance(value, ast.Call):
+            if self._is_jit_call(value):
+                return True
+            if isinstance(value.func, ast.Name):
+                g = self.resolve(scope, value.func.id)
+                if g is not None and g.returns_jitted:
+                    return True
+        if isinstance(value, ast.Name) and scope is not None:
+            f = scope
+            while f is not None:
+                if value.id in f.jitted_vars:
+                    return True
+                f = f.parent
+            return value.id in self.module_jitted
+        return False
+
+    def _analyze_locals(self, f: _Func) -> None:
+        # returned funcs / returns_jitted
+        for node in _own_walk(f.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if isinstance(node.value, ast.Name):
+                    g = self.resolve(f, node.value.id)
+                    if g is not None and g in f.children:
+                        f.returned_funcs.append(g)
+                if isinstance(node.value, ast.Call) and self._is_jit_call(node.value):
+                    f.returns_jitted = True
+        for node in _own_walk(f.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(
+                node.targets[0], ast.Name
+            ):
+                tname = node.targets[0].id
+                if self._value_is_jitted(f, node.value):
+                    f.jitted_vars.add(tname)
+                g = self._factory_for_value(f, node.value)
+                if g is not None:
+                    f.factory_vars[tname] = g
+        # call edges from f's own code
+        for node in _own_walk(f.node):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                g = self.resolve(f, node.func.id)
+                if g is not None and g is not f:
+                    f.call_targets.append(g)
+                fac = f.factory_vars.get(node.func.id)
+                if fac is not None:
+                    f.call_targets.extend(fac.returned_funcs)
+
+    def module_own_walk(self):
+        """Walks module-level code, not descending into function bodies."""
+        stack = list(ast.iter_child_nodes(self.tree))
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _module_scope_jitted(self) -> None:
+        for node in ast.iter_child_nodes(self.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and self._value_is_jitted(None, node.value)
+            ):
+                self.module_jitted.add(node.targets[0].id)
+
+    # ------------------------------------------------------------ traced set
+    def _transform_fn_args(self, call: ast.Call) -> list[ast.AST]:
+        """Function-valued arguments of a tracing-transform call."""
+        name = _tail(_dotted(call.func))
+        if name not in _TRACING_TRANSFORMS:
+            # partial(jax.jit, ...)(f) style: treat partial over a transform
+            # as the transform itself.
+            if (
+                isinstance(call.func, ast.Call)
+                and _tail(_dotted(call.func.func)) in ("partial",)
+                and call.func.args
+                and _tail(_dotted(call.func.args[0])) in _TRACING_TRANSFORMS
+            ):
+                return list(call.args)
+            return []
+        return list(call.args) + [kw.value for kw in call.keywords]
+
+    def _mark_traced(self) -> None:
+        self._module_scope_jitted()
+        roots: list[_Func] = []
+
+        def mark_value(scope: _Func | None, value: ast.AST) -> None:
+            if isinstance(value, ast.Name):
+                g = self.resolve(scope, value.id)
+                if g is not None:
+                    roots.append(g)
+                elif scope is not None:
+                    fac = scope.factory_vars.get(value.id)
+                    if fac is not None:
+                        roots.extend(fac.returned_funcs)
+            elif isinstance(value, ast.Lambda):
+                g = self.by_node.get(value)
+                if g is not None:
+                    roots.append(g)
+            elif isinstance(value, ast.Call):
+                fac = None
+                if isinstance(value.func, ast.Name) and scope is not None:
+                    fac = self.resolve(scope, value.func.id)
+                elif isinstance(value.func, ast.Name):
+                    fac = self.resolve(None, value.func.id)
+                if fac is not None:
+                    roots.extend(fac.returned_funcs)
+
+        # decorator roots
+        for f in self.funcs:
+            for dec in getattr(f.node, "decorator_list", []):
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                names = {_tail(_dotted(d))}
+                if isinstance(dec, ast.Call):
+                    names |= {_tail(_dotted(a)) for a in dec.args}
+                if names & _TRACING_TRANSFORMS:
+                    roots.append(f)
+        # transform-call roots (module and function scopes)
+        for node in self.module_own_walk():
+            if isinstance(node, ast.Call):
+                for arg in self._transform_fn_args(node):
+                    mark_value(None, arg)
+        for f in self.funcs:
+            for node in _own_walk(f.node):
+                if isinstance(node, ast.Call):
+                    for arg in self._transform_fn_args(node):
+                        mark_value(f, arg)
+
+        # propagate: traced f => nested defs + same-module callees traced
+        work = list(roots)
+        while work:
+            f = work.pop()
+            if f.traced:
+                continue
+            f.traced = True
+            work.extend(f.children)
+            work.extend(f.call_targets)
+
+
+# ---------------------------------------------------------------- rule checks
+class _Linter:
+    def __init__(self, src: str, path: str):
+        self.src = src
+        self.path = path
+        self.lines = src.splitlines()
+        self.findings: list[Finding] = []
+        self.tree = ast.parse(src, filename=path)
+        _annotate_assign_names(self.tree)
+        self.mod = _Module(self.tree, path, self.lines)
+        self.allowed = self._parse_allows()
+
+    def _parse_allows(self) -> dict[int, set[str]]:
+        allows: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                allows[i] = {r.strip() for r in m.group("rules").split(",")}
+        return allows
+
+    def add(self, node: ast.AST, rule: str, message: str, hint: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if rule in self.allowed.get(line, ()):
+            return
+        snippet = self.lines[line - 1].strip() if line - 1 < len(self.lines) else ""
+        self.findings.append(
+            Finding(self.path, line, getattr(node, "col_offset", 0), rule, message, hint, snippet)
+        )
+
+    def run(self) -> list[Finding]:
+        self.check_gc001()
+        self.check_gc002()
+        self.check_gc003()
+        self.check_gc004()
+        self.check_gc005()
+        # The loop scan can reach one site via several paths (direct + shared
+        # helpers) — one site, one finding.
+        seen: set[tuple[int, int, str]] = set()
+        unique: list[Finding] = []
+        for f in sorted(self.findings, key=lambda f: (f.line, f.col, f.rule)):
+            if (f.line, f.col, f.rule) not in seen:
+                seen.add((f.line, f.col, f.rule))
+                unique.append(f)
+        self.findings = unique
+        return self.findings
+
+    # ------------------------------------------------------------- GC001
+    def _sync_call(self, node: ast.Call) -> str | None:
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_ATTR_METHODS:
+            return _SYNC_ATTR_METHODS[node.func.attr]
+        dotted = _dotted(node.func)
+        if dotted in _SYNC_DOTTED:
+            return _SYNC_DOTTED[dotted]
+        if isinstance(node.func, ast.Name) and node.func.id in _SYNC_BUILTINS:
+            # float(CONSTANT) / float("inf") are host-only literals, not syncs.
+            if node.args and isinstance(node.args[0], ast.Constant):
+                return None
+            return _SYNC_BUILTINS[node.func.id]
+        return None
+
+    def check_gc001(self) -> None:
+        hint_traced = (
+            "keep values on device inside traced code; compute reductions with jnp and "
+            "read results back outside the jitted scope"
+        )
+        hint_loop = (
+            "buffer device scalars (e.g. losses) and convert once per epoch/window flush "
+            "after the dispatch queue drains; see training/pretrain.py pending-log pattern"
+        )
+        for f in self.mod.funcs:
+            if not f.traced:
+                continue
+            for node in _own_walk(f.node):
+                if isinstance(node, ast.Call):
+                    why = self._sync_call(node)
+                    if why:
+                        self.add(
+                            node, "GC001",
+                            f"host sync in traced scope `{f.name}`: {why}",
+                            hint_traced,
+                        )
+        # dispatch-loop scan: loops that call a known jitted callable
+        for f in self.mod.funcs:
+            if f.traced:
+                continue
+            jitted = set(self.mod.module_jitted)
+            g: _Func | None = f
+            while g is not None:
+                jitted |= g.jitted_vars
+                g = g.parent
+            if not jitted:
+                continue
+            for node in _own_walk(f.node):
+                if not isinstance(node, (ast.For, ast.While)):
+                    continue
+                called, helper_funcs = self._loop_calls(f, node)
+                if not (called & jitted):
+                    continue
+                self._scan_loop_syncs(node, f.name, hint_loop)
+                for h in helper_funcs:
+                    if not h.traced:
+                        self._scan_loop_syncs(h.node, f.name, hint_loop, helper=h.name)
+
+    def _loop_calls(self, f: _Func, loop: ast.AST) -> tuple[set[str], list[_Func]]:
+        """Names called in a loop body + local helper funcs reached from it."""
+        called: set[str] = set()
+        helpers: list[_Func] = []
+        seen: set[_Func] = set()
+        stack = [loop]
+        while stack:
+            scope_node = stack.pop()
+            walker = (
+                _walk_shallow(scope_node) if scope_node is loop else _own_walk(scope_node)
+            )
+            for node in walker:
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    called.add(node.func.id)
+                    h = self.mod.resolve(f, node.func.id)
+                    if h is not None and h.parent is not None and h not in seen:
+                        seen.add(h)
+                        helpers.append(h)
+                        stack.append(h.node)
+        return called, helpers
+
+    def _scan_loop_syncs(self, scope_node, loop_fn: str, hint: str, helper: str | None = None):
+        where = f"jitted-dispatch loop in `{loop_fn}`" + (
+            f" (via helper `{helper}`)" if helper else ""
+        )
+        it = (
+            _own_walk(scope_node)
+            if isinstance(scope_node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else _walk_shallow(scope_node)
+        )
+        for node in it:
+            if isinstance(node, ast.Call):
+                why = self._sync_call(node)
+                if why:
+                    self.add(node, "GC001", f"host sync inside {where}: {why}", hint)
+
+    # ------------------------------------------------------------- GC002
+    def _f64_allowlisted(self) -> bool:
+        p = self.path.replace("\\", "/")
+        if any(d in p for d in F64_ALLOWLIST_DIRS):
+            return True
+        return p.rsplit("/", 1)[-1] in F64_ALLOWLIST_FILES
+
+    def check_gc002(self) -> None:
+        if self._f64_allowlisted():
+            return
+        hint = (
+            "use float32 (or bf16) on the accelerator path; f64 belongs only in "
+            "host-side preprocessing (data/preprocessing/, dataset_pandas.py, synthetic.py)"
+        )
+        f64_strs = {"float64", "f8", ">f8", "<f8", "double"}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute) and node.attr in ("float64", "double"):
+                root = _dotted(node)
+                if root and root.split(".")[0] in ("np", "numpy", "jnp", "jax"):
+                    self.add(node, "GC002", f"float64 dtype `{root}`", hint)
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "dtype"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value in f64_strs
+                    ):
+                        self.add(node, "GC002", f'float64 dtype string "{kw.value.value}"', hint)
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"
+                    and node.args
+                ):
+                    a = node.args[0]
+                    if isinstance(a, ast.Constant) and a.value in f64_strs:
+                        self.add(node, "GC002", f'astype("{a.value}")', hint)
+                if (
+                    _dotted(node.func) == "jax.config.update"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value == "jax_enable_x64"
+                ):
+                    self.add(node, "GC002", "jax_enable_x64 flips every default dtype to f64", hint)
+
+    # ------------------------------------------------------------- GC003
+    def check_gc003(self) -> None:
+        for f in self.mod.funcs:
+            self._scan_keys(f)
+
+    def _scan_keys(self, f: _Func) -> None:
+        hint = (
+            "split before each consumption: `key, sub = jax.random.split(key)` (or "
+            "`fold_in` on a loop counter) so no key is sampled from twice"
+        )
+        uses_jax_random = any(
+            isinstance(n, ast.Call)
+            and (_dotted(n.func) or "").startswith(("jax.random.", "jr.", "jrandom."))
+            for n in _own_walk(f.node)
+        )
+        key_vars: dict[str, int] = {}  # name -> uses since last (re)split
+        node_ref = f.node
+        if uses_jax_random and not isinstance(node_ref, ast.Lambda):
+            for arg in list(node_ref.args.args) + list(node_ref.args.kwonlyargs):
+                if _KEY_PARAM_RE.search(arg.arg):
+                    key_vars[arg.arg] = 0
+        reported: set[tuple[int, str]] = set()
+
+        def is_producer(value: ast.AST) -> bool:
+            if not isinstance(value, ast.Call):
+                return False
+            d = _dotted(value.func)
+            if d is None:
+                return False
+            parts = d.split(".")
+            return parts[-1] in _KEY_PRODUCERS and (
+                len(parts) == 1 or "random" in parts or parts[0] in ("jr", "jrandom")
+            )
+
+        def walk_to_calls(node: ast.AST):
+            """Yields nodes of an arg subtree, stopping at nested calls and
+            function bodies (nested calls count their own args separately)
+            and at subscripts (``ks[0]``/``ks[1]`` from one split are
+            distinct keys, not reuse of ``ks``)."""
+            stack = [node]
+            while stack:
+                n = stack.pop()
+                yield n
+                if isinstance(n, (ast.Call, ast.FunctionDef, ast.Lambda, ast.Subscript)):
+                    continue
+                stack.extend(ast.iter_child_nodes(n))
+
+        def record_uses(expr: ast.AST, state: dict[str, int]) -> None:
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                # `fold_in(key, data)` is the sanctioned re-derivation idiom
+                # (fresh stream per distinct data) — not a consumption.
+                if _tail(_dotted(node.func)) in ("fold_in", "clone"):
+                    continue
+                for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                    for leaf in walk_to_calls(sub):
+                        if (
+                            isinstance(leaf, ast.Name)
+                            and isinstance(leaf.ctx, ast.Load)
+                            and leaf.id in state
+                        ):
+                            state[leaf.id] += 1
+                            if state[leaf.id] > 1 and (leaf.lineno, leaf.id) not in reported:
+                                reported.add((leaf.lineno, leaf.id))
+                                self.add(
+                                    leaf, "GC003",
+                                    f"PRNG key `{leaf.id}` consumed again without an "
+                                    "intervening split/fold_in",
+                                    hint,
+                                )
+
+        def assign_targets(targets, value, state: dict[str, int]) -> None:
+            names: list[str] = []
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    names.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+            if is_producer(value):
+                for n in names:
+                    state[n] = 0
+            else:
+                for n in names:
+                    state.pop(n, None)
+
+        # exec_* return a terminator kind: "return" (function exit, propagates
+        # out of branch merges), "break" (absorbed by the enclosing loop), or
+        # None. A branch that exits early must not leak its use counts into
+        # the fall-through path — `if fast: return f(key)` + later uses of
+        # `key` are alternatives, not reuse.
+        def exec_stmt(st: ast.stmt, state: dict[str, int]) -> str | None:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                return None
+            if isinstance(st, ast.Assign):
+                record_uses(st.value, state)
+                assign_targets(st.targets, st.value, state)
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                record_uses(st.value, state)
+                assign_targets([st.target], st.value, state)
+            elif isinstance(st, ast.If):
+                record_uses(st.test, state)
+                s_body, s_else = dict(state), dict(state)
+                t_body = exec_block(st.body, s_body)
+                t_else = exec_block(st.orelse, s_else)
+                state.clear()
+                if t_body and t_else:
+                    state.update(s_body)
+                    return "return" if "return" in (t_body, t_else) else t_body
+                if t_body:
+                    state.update(s_else)
+                elif t_else:
+                    state.update(s_body)
+                else:
+                    for k in set(s_body) | set(s_else):
+                        if k in s_body and k in s_else:
+                            state[k] = max(s_body[k], s_else[k])
+                        else:
+                            state[k] = s_body.get(k, s_else.get(k, 0))
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(st, ast.While):
+                    record_uses(st.test, state)
+                else:
+                    record_uses(st.iter, state)
+                # two abstract iterations: a key consumed each pass without a
+                # split/fold_in reassignment inside the loop is reuse
+                t = exec_block(st.body, state)
+                if t is None:
+                    t = exec_block(st.body, state)
+                if t == "return":
+                    return "return"
+                exec_block(st.orelse, state)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    record_uses(item.context_expr, state)
+                return exec_block(st.body, state)
+            elif isinstance(st, ast.Try):
+                t = exec_block(st.body, state)
+                for h in st.handlers:
+                    exec_block(h.body, dict(state))
+                exec_block(st.orelse, state)
+                exec_block(st.finalbody, state)
+                return t
+            elif isinstance(st, ast.Return):
+                if st.value is not None:
+                    record_uses(st.value, state)
+                return "return"
+            elif isinstance(st, ast.Raise):
+                return "return"
+            elif isinstance(st, (ast.Break, ast.Continue)):
+                return "break"
+            elif isinstance(st, ast.Expr):
+                record_uses(st.value, state)
+            elif isinstance(st, ast.AugAssign):
+                record_uses(st.value, state)
+            return None
+
+        def exec_block(stmts, state: dict[str, int]) -> str | None:
+            for st in stmts:
+                t = exec_stmt(st, state)
+                if t is not None:
+                    return t
+            return None
+
+        body = f.node.body if not isinstance(f.node, ast.Lambda) else []
+        exec_block(body, key_vars)
+
+    # ------------------------------------------------------------- GC004
+    def _traced_hits(self, expr: ast.AST, tainted: set[str]) -> list[ast.Name]:
+        """Tainted names used as *values* (not static metadata) in ``expr``."""
+        hits: list[ast.Name] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load) and node.id in tainted:
+                    hits.append(node)
+            elif isinstance(node, ast.Attribute):
+                if node.attr in _STATIC_ATTRS:
+                    return  # x.shape / x.ndim / x.dtype are trace-time facts
+                # plain attribute data access (configs, dataclass fields) is
+                # treated as static; calls on attributes are handled below
+                return
+            elif isinstance(node, ast.Call):
+                fname = _tail(_dotted(node.func))
+                if fname in _STATIC_BUILTIN_CALLS:
+                    return
+                if isinstance(node.func, ast.Attribute):
+                    # x.sum() / x.any(): the receiver is consumed as a value
+                    visit_value(node.func.value)
+                for a in node.args:
+                    visit(a)
+                for kw in node.keywords:
+                    visit(kw.value)
+            elif isinstance(node, ast.Compare):
+                if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                    return  # `x is None` identity checks are static
+                for child in [node.left, *node.comparators]:
+                    visit(child)
+            elif isinstance(node, ast.Subscript):
+                visit_value(node.value)
+                visit(node.slice)
+            else:
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+
+        def visit_value(node: ast.AST) -> None:
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load) and node.id in tainted:
+                    hits.append(node)
+            else:
+                visit(node)
+
+        visit(expr)
+        return hits
+
+    def _has_jax_call(self, expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and d.split(".")[0] in ("jnp", "jax", "lax", "nn"):
+                    return True
+        return False
+
+    def _static_jit_params(self, f: _Func) -> set:
+        """Params a jit decorator declares static (names and argnums)."""
+        static: set = set()
+        for dec in getattr(f.node, "decorator_list", []):
+            if not isinstance(dec, ast.Call):
+                continue
+            names = {_tail(_dotted(dec.func))}
+            names |= {_tail(_dotted(a)) for a in dec.args}
+            if not (names & _JIT_NAMES):
+                continue
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnames", "static_argnums"):
+                    vals = (
+                        kw.value.elts
+                        if isinstance(kw.value, (ast.Tuple, ast.List))
+                        else [kw.value]
+                    )
+                    for v in vals:
+                        if isinstance(v, ast.Constant):
+                            static.add(v.value)
+        return static
+
+    def check_gc004(self) -> None:
+        hint = (
+            "branch on trace-time facts only (shapes, dtypes, config flags) or use "
+            "jnp.where / jax.lax.cond / jax.lax.while_loop for value-dependent control flow"
+        )
+        for f in self.mod.funcs:
+            if not f.traced or isinstance(f.node, ast.Lambda):
+                continue
+            static_params = self._static_jit_params(f)
+            tainted = set()
+            args = (
+                list(f.node.args.posonlyargs)
+                + list(f.node.args.args)
+                + list(f.node.args.kwonlyargs)
+            )
+            for i, a in enumerate(args):
+                if a.arg in ("self", "cls") or a.arg in static_params:
+                    continue
+                if i in static_params:
+                    continue
+                # plain-Python annotations are static by construction
+                ann = getattr(a.annotation, "id", None)
+                if ann in ("str", "bool", "int", "float"):
+                    continue
+                tainted.add(a.arg)
+            for node in _own_walk(f.node):
+                if isinstance(node, ast.Assign):
+                    is_traced_val = bool(self._traced_hits(node.value, tainted)) or (
+                        self._has_jax_call(node.value)
+                    )
+                    for t in node.targets:
+                        names = (
+                            [t.id]
+                            if isinstance(t, ast.Name)
+                            else [e.id for e in getattr(t, "elts", []) if isinstance(e, ast.Name)]
+                        )
+                        for n in names:
+                            (tainted.add if is_traced_val else tainted.discard)(n)
+            for node in _own_walk(f.node):
+                if isinstance(node, (ast.If, ast.While)):
+                    hits = self._traced_hits(node.test, tainted)
+                    if hits:
+                        kind = "while" if isinstance(node, ast.While) else "if"
+                        self.add(
+                            node, "GC004",
+                            f"Python `{kind}` on traced value `{hits[0].id}` in traced "
+                            f"scope `{f.name}`",
+                            hint,
+                        )
+
+    # ------------------------------------------------------------- GC005
+    def check_gc005(self) -> None:
+        hint = (
+            "donate the state: jax.jit(step, donate_argnums=(0,)) so parameters and "
+            "optimizer moments update in place instead of double-buffering HBM"
+        )
+
+        def jit_target_names(call: ast.Call, scope: _Func | None) -> set[str]:
+            names: set[str] = set()
+            if call.args:
+                a = call.args[0]
+                if isinstance(a, ast.Name):
+                    names.add(a.id)
+                elif isinstance(a, ast.Call):
+                    t = _tail(_dotted(a.func))
+                    if t:
+                        names.add(t)
+            return names
+
+        scopes: list[tuple] = [(self.mod.module_own_walk(), None)]
+        scopes += [(_own_walk(f.node), f) for f in self.mod.funcs]
+        for walker, scope in scopes:
+            for node in walker:
+                if not isinstance(node, ast.Call) or _tail(_dotted(node.func)) not in _JIT_NAMES:
+                    continue
+                kwargs = {kw.arg for kw in node.keywords}
+                if kwargs & {"donate_argnums", "donate_argnames"}:
+                    continue
+                names = jit_target_names(node, scope)
+                # the assignment target also names the step
+                parent_assign = getattr(node, "_gc_parent_assign", None)
+                if parent_assign:
+                    names |= parent_assign
+                if any("train" in n.lower() for n in names):
+                    self.add(
+                        node, "GC005",
+                        f"train-step jit of `{'/'.join(sorted(names))}` without donation",
+                        hint,
+                    )
+        # decorator form: @jax.jit on a def whose name says train
+        for f in self.mod.funcs:
+            for dec in getattr(f.node, "decorator_list", []):
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                if _tail(_dotted(d)) in _JIT_NAMES and "train" in f.name.lower():
+                    kwargs = (
+                        {kw.arg for kw in dec.keywords} if isinstance(dec, ast.Call) else set()
+                    )
+                    if not (kwargs & {"donate_argnums", "donate_argnames"}):
+                        self.add(
+                            dec, "GC005",
+                            f"train-step jit of `{f.name}` without donation",
+                            hint,
+                        )
+
+
+def _annotate_assign_names(tree: ast.Module) -> None:
+    """Tags jit calls with their assignment-target names (for GC005)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            names = {
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            }
+            if names:
+                node.value._gc_parent_assign = names  # type: ignore[attr-defined]
+
+
+# ------------------------------------------------------------------ public API
+def lint_source(src: str, path: str = "<memory>") -> list[Finding]:
+    """Lints one module's source; ``path`` keys findings and the f64 allowlist."""
+    return _Linter(src, path).run()
+
+
+def default_targets(repo_root: Path) -> list[Path]:
+    """The lint scope: the package, the scripts, and the driver entry."""
+    targets: list[Path] = []
+    for rel in ("eventstreamgpt_tpu", "scripts"):
+        d = repo_root / rel
+        if d.is_dir():
+            targets.extend(sorted(d.rglob("*.py")))
+    entry = repo_root / "__graft_entry__.py"
+    if entry.exists():
+        targets.append(entry)
+    return targets
+
+
+def lint_paths(paths: list[Path], repo_root: Path) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in paths:
+        try:
+            rel = p.resolve().relative_to(repo_root.resolve()).as_posix()
+        except ValueError:  # outside the repo (ad-hoc file): absolute key
+            rel = p.resolve().as_posix()
+        findings.extend(lint_source(p.read_text(), rel))
+    return findings
+
+
+# ------------------------------------------------------------------- baseline
+def load_baseline(fp: Path) -> dict[tuple[str, str, str], int]:
+    if not Path(fp).exists():
+        return {}
+    data = json.loads(Path(fp).read_text())
+    out: dict[tuple[str, str, str], int] = {}
+    for rec in data.get("findings", []):
+        out[(rec["path"], rec["rule"], rec["snippet"])] = int(rec.get("count", 1))
+    return out
+
+
+def save_baseline(findings: list[Finding], fp: Path) -> None:
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    recs = [
+        {"path": p, "rule": r, "snippet": s, "count": c}
+        for (p, r, s), c in sorted(counts.items())
+    ]
+    Path(fp).write_text(
+        json.dumps(
+            {
+                "note": (
+                    "graftcheck lint baseline: pre-existing findings suppressed by key "
+                    "(path, rule, snippet). New findings fail; shrink this file, never "
+                    "grow it. Regenerate with scripts/graftcheck.py --write-baseline."
+                ),
+                "findings": recs,
+            },
+            indent=1,
+        )
+        + "\n"
+    )
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[tuple[str, str, str], int]
+) -> tuple[list[Finding], int]:
+    """Splits findings into (new, n_suppressed) under the baseline budget."""
+    budget = dict(baseline)
+    new: list[Finding] = []
+    suppressed = 0
+    for f in findings:
+        if budget.get(f.key(), 0) > 0:
+            budget[f.key()] -= 1
+            suppressed += 1
+        else:
+            new.append(f)
+    return new, suppressed

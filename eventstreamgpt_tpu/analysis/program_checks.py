@@ -1,0 +1,273 @@
+"""Tier B of graftcheck: jaxpr/HLO invariant gates over canonical programs.
+
+PR 1 proved "no table-sized collectives" and "device-resident hot loop" via
+runtime tests; these properties are static facts of the lowered program, so
+this module gates them on every PR with no hardware and no epoch runs. It
+AOT-lowers the canonical step programs — the pretrain train step on the
+``dp8`` and ``dp4_tp2`` virtual-mesh layouts, the fine-tuning train step,
+and the single-dispatch generation program — and statically asserts:
+
+* **no f64** element types anywhere in the module (TPUs emulate f64; one
+  stray weak-typed ``np.float64`` constant doubles a table),
+* **no host transfers** in the step (outfeed/infeed/send/recv and
+  host-callback custom-calls — a ``jax.debug.print`` or ``pure_callback``
+  smuggled into the hot loop),
+* **collective payload bytes within tolerance** of the committed
+  ``COLLECTIVES.json`` budget (``parallel.collectives_audit
+  .compare_inventory``) — an accidental full-table all-gather is a byte
+  blowup here long before it is a pod-hour.
+
+The f64 / host-transfer checks run on the *unoptimized* lowering (fast — no
+XLA compile); the collective budget needs the optimized HLO, so those
+layouts compile (CPU, tiny shapes, ~a minute each). Requires the 8-device
+virtual CPU mesh (``__graft_entry__._provision_cpu_devices(8)`` before jax
+backend init — the graftcheck CLI and tests/conftest.py both do this).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+__all__ = [
+    "REPO_ROOT",
+    "canonical_pretrain_step",
+    "canonical_finetune_step",
+    "canonical_generation_program",
+    "check_no_f64",
+    "check_no_host_transfers",
+    "check_collective_budget",
+    "run_program_checks",
+]
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+# f64 element types in HLO ("f64[...]") or StableHLO ("tensor<2x3xf64>",
+# "tensor<f64>") syntax. Substring-only matching would false-positive on
+# hex-ish identifiers, so anchor to the type syntax.
+_F64_RE = re.compile(r"f64\[|x\s*f64>|<f64>|tensor<f64")
+
+_HOST_OP_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|\S+)\s+(outfeed|infeed|send|send-done|recv|recv-done)\("
+)
+_CUSTOM_CALL_TARGET_RE = re.compile(r'custom_call_target\s*=\s*"([^"]+)"')
+_STABLEHLO_CUSTOM_RE = re.compile(r"stablehlo\.custom_call\s+@(\S+?)[(\s]")
+_HOST_CALLBACK_RE = re.compile(r"callback|host|outfeed|infeed|debug_print", re.IGNORECASE)
+
+
+def _graft_entry():
+    """Imports ``__graft_entry__`` (model/batch builders live beside it)."""
+    if str(REPO_ROOT) not in sys.path:
+        sys.path.insert(0, str(REPO_ROOT))
+    import __graft_entry__
+
+    return __graft_entry__
+
+
+def _require_devices(n: int) -> None:
+    import jax
+
+    have = len(jax.devices())
+    if have < n:
+        raise RuntimeError(
+            f"program checks need an {n}-device mesh but jax reports {have}; "
+            "provision the virtual CPU platform before importing jax "
+            "(__graft_entry__._provision_cpu_devices) — the graftcheck CLI and "
+            "tests/conftest.py both do."
+        )
+
+
+# ----------------------------------------------------------- canonical steps
+def canonical_pretrain_step(n_data: int, n_model: int):
+    """The production pretrain train step on a ``data×model`` mesh — the
+    exact construction ``dryrun_multichip`` audits into ``COLLECTIVES.json``
+    (same tiny shapes, so inventories are directly comparable)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.config import OptimizationConfig
+    from ..training import TrainState, build_optimizer, make_train_step, shard_batch
+    from ..training.sharding import make_mesh, shard_state
+
+    ge = _graft_entry()
+    _require_devices(n_data * n_model)
+    mesh = make_mesh(n_data, n_model)
+    model, batch = ge._make_model_and_batch(batch_size=2 * n_data)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    oc = OptimizationConfig(
+        init_lr=1e-3,
+        batch_size=2 * n_data,
+        max_training_steps=10,
+        lr_num_warmup_steps=1,
+        lr_frac_warmup_steps=None,
+    )
+    tx, _ = build_optimizer(oc)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
+    state = shard_state(state, mesh)
+    batch = shard_batch(batch, mesh)
+    step = make_train_step(model, tx)
+    return step, (state, batch, jax.random.PRNGKey(0))
+
+
+def canonical_finetune_step(n_data: int = 8):
+    """The fine-tuning (stream classification) train step, data-parallel."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.config import OptimizationConfig, StructuredTransformerConfig
+    from ..models.fine_tuning_model import ESTForStreamClassification
+    from ..training import TrainState, build_optimizer, make_train_step, shard_batch
+    from ..training.sharding import make_mesh, shard_state
+
+    ge = _graft_entry()
+    _require_devices(n_data)
+    mesh = make_mesh(n_data, 1)
+    base_model, batch = ge._make_model_and_batch(batch_size=2 * n_data)
+    config = StructuredTransformerConfig.from_dict(
+        {
+            **base_model.config.to_dict(),
+            "finetuning_task": "label",
+            "id2label": {0: False, 1: True},
+            "num_labels": 2,
+            "problem_type": "single_label_classification",
+            "task_specific_params": {"pooling_method": "last"},
+        }
+    )
+    model = ESTForStreamClassification(config)
+    labels = np.arange(2 * n_data, dtype=np.int64) % 2
+    batch = batch.replace(stream_labels={"label": jnp.asarray(labels)})
+    params = model.init(jax.random.PRNGKey(0), batch)
+    oc = OptimizationConfig(
+        init_lr=1e-3,
+        batch_size=2 * n_data,
+        max_training_steps=10,
+        lr_num_warmup_steps=1,
+        lr_frac_warmup_steps=None,
+    )
+    tx, _ = build_optimizer(oc)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
+    state = shard_state(state, mesh)
+    batch = shard_batch(batch, mesh)
+    step = make_train_step(model, tx)
+    return step, (state, batch, jax.random.PRNGKey(0))
+
+
+def canonical_generation_program(max_new_events: int = 4):
+    """The single-dispatch cached generation program (``generate_program``)."""
+    import jax
+
+    from ..generation.generation_utils import _build_ci_steps
+
+    ge = _graft_entry()
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    steps = _build_ci_steps(
+        model, model.config, B=2, input_len=8, max_new_events=max_new_events
+    )
+    return steps["generate_program"], (params, batch, jax.random.PRNGKey(0))
+
+
+# ------------------------------------------------------------------- checks
+def check_no_f64(program_text: str, label: str = "program") -> list[str]:
+    """No f64 element types anywhere in the lowered/compiled module."""
+    problems = []
+    for i, line in enumerate(program_text.splitlines(), start=1):
+        if _F64_RE.search(line):
+            problems.append(f"{label}: f64 element type at module line {i}: {line.strip()[:160]}")
+    return problems
+
+
+def check_no_host_transfers(program_text: str, label: str = "program") -> list[str]:
+    """No outfeed/infeed/send/recv and no host-callback custom-calls."""
+    problems = []
+    for i, line in enumerate(program_text.splitlines(), start=1):
+        m = _HOST_OP_RE.search(line)
+        if m:
+            problems.append(
+                f"{label}: host transfer op `{m.group(1)}` at module line {i}: "
+                f"{line.strip()[:160]}"
+            )
+            continue
+        for target_m in _CUSTOM_CALL_TARGET_RE.finditer(line):
+            if _HOST_CALLBACK_RE.search(target_m.group(1)):
+                problems.append(
+                    f"{label}: host-callback custom-call `{target_m.group(1)}` "
+                    f"at module line {i}"
+                )
+        sm = _STABLEHLO_CUSTOM_RE.search(line)
+        if sm and _HOST_CALLBACK_RE.search(sm.group(1)):
+            problems.append(
+                f"{label}: host-callback custom-call `{sm.group(1)}` at module line {i}"
+            )
+    return problems
+
+
+def check_collective_budget(
+    inventory: dict, layout: str, budget_path: Path, rel_tol: float = 0.25
+) -> list[str]:
+    """Inventory vs the committed per-layout budget in ``COLLECTIVES.json``."""
+    from ..parallel import compare_inventory
+
+    budgets = json.loads(Path(budget_path).read_text())["layouts"]
+    if layout not in budgets:
+        return [f"{layout}: no budget entry in {budget_path}"]
+    return [f"{layout}: {p}" for p in compare_inventory(inventory, budgets[layout], rel_tol)]
+
+
+# ------------------------------------------------------------------- runner
+def run_program_checks(
+    budget_path: Path | None = None,
+    rel_tol: float = 0.25,
+    compile_collectives: bool = True,
+    verbose: bool = True,
+) -> list[str]:
+    """Runs every Tier-B gate; returns violations (empty ⇒ all gates pass).
+
+    Fast gates (f64-free, host-transfer-free) run on the unoptimized
+    lowering of all canonical programs. With ``compile_collectives`` the
+    ``dp8`` / ``dp4_tp2`` pretrain layouts also compile and gate their
+    collective inventories against ``COLLECTIVES.json``.
+    """
+    from ..parallel import collective_inventory
+
+    if budget_path is None:
+        budget_path = REPO_ROOT / "COLLECTIVES.json"
+    problems: list[str] = []
+
+    def log(msg: str) -> None:
+        if verbose:
+            print(f"graftcheck[B]: {msg}", flush=True)
+
+    layouts = {"dp8": (8, 1), "dp4_tp2": (4, 2)}
+    programs: dict[str, tuple] = {}
+    for name, (n_data, n_model) in layouts.items():
+        programs[f"pretrain:{name}"] = canonical_pretrain_step(n_data, n_model)
+    programs["finetune:dp8"] = canonical_finetune_step(8)
+    programs["generation:ci"] = canonical_generation_program()
+
+    lowered = {}
+    for label, (fn, args) in programs.items():
+        log(f"lowering {label}")
+        lowered[label] = fn.lower(*args)
+        text = lowered[label].as_text()
+        problems += check_no_f64(text, label)
+        problems += check_no_host_transfers(text, label)
+
+    if compile_collectives:
+        for name in layouts:
+            label = f"pretrain:{name}"
+            log(f"compiling {label} for the collective budget gate")
+            compiled = lowered[label].compile()
+            text = compiled.as_text()
+            problems += check_no_f64(text, f"{label} (optimized)")
+            problems += check_no_host_transfers(text, f"{label} (optimized)")
+            inv = collective_inventory(text)
+            log(
+                f"{label}: {inv['total_count']} collectives, "
+                f"{inv['total_bytes']} payload bytes"
+            )
+            problems += check_collective_budget(inv, name, budget_path, rel_tol)
+    return problems

@@ -330,6 +330,7 @@ class DeviceDataset:
         dataset: JaxDataset,
         mesh: Mesh | None = None,
         context_parallel: bool = False,
+        batch_sizes: tuple[int, ...] = (),
     ) -> "DeviceDataset":
         """Topology-aware constructor (no budget gate).
 
@@ -339,7 +340,10 @@ class DeviceDataset:
         ``ValueError`` with an actionable message on unsupported topologies
         (no mesh / no ``data`` axis / fewer subjects than shards) instead of
         silently misbehaving — this is the path explicit
-        ``device_resident_data: true`` configs take.
+        ``device_resident_data: true`` configs take. ``batch_sizes`` (every
+        size the caller will stream, train AND eval) is validated against
+        the shard count HERE, at startup — the alternative is a full epoch
+        of pod time before the first dealt eval stream raises.
         """
         if jax.process_count() == 1:
             return cls(dataset, mesh=mesh, context_parallel=context_parallel)
@@ -350,11 +354,20 @@ class DeviceDataset:
                 "shard over it); this caller passed "
                 f"mesh={'None' if mesh is None else tuple(mesh.shape.items())}."
             )
+        n_shards = int(mesh.shape["data"])
+        bad = [int(b) for b in batch_sizes if int(b) % n_shards]
+        if bad:
+            raise ValueError(
+                f"device-resident data shards the plan stream {n_shards} ways, so "
+                f"every streamed batch size must be divisible by {n_shards}; got "
+                f"{bad}. Adjust the batch/validation batch size or disable "
+                "device_resident_data."
+            )
         return cls(
             dataset,
             mesh=mesh,
             context_parallel=context_parallel,
-            data_shards=int(mesh.shape["data"]),
+            data_shards=n_shards,
         )
 
     @classmethod

@@ -9,13 +9,14 @@ results — communication overlaps compute and no device ever materializes
 the full sequence.
 """
 
-from .collectives_audit import audit_step, collective_inventory
+from .collectives_audit import audit_step, collective_inventory, compare_inventory
 from .context import current_ring_context, ring_context
 from .ring_attention import ring_attention, ring_attention_shard
 
 __all__ = [
     "audit_step",
     "collective_inventory",
+    "compare_inventory",
     "current_ring_context",
     "ring_attention",
     "ring_attention_shard",

@@ -25,7 +25,12 @@ from __future__ import annotations
 import math
 import re
 
-__all__ = ["collective_inventory", "audit_step", "COLLECTIVE_KINDS"]
+__all__ = [
+    "collective_inventory",
+    "audit_step",
+    "compare_inventory",
+    "COLLECTIVE_KINDS",
+]
 
 COLLECTIVE_KINDS = (
     "all-reduce",
@@ -97,6 +102,45 @@ def collective_inventory(hlo_text: str) -> dict:
     inv["total_bytes"] = sum(v["bytes"] for v in inv.values() if isinstance(v, dict))
     inv["total_count"] = sum(v["count"] for v in inv.values() if isinstance(v, dict))
     return inv
+
+
+def compare_inventory(
+    inventory: dict,
+    budget: dict,
+    rel_tol: float = 0.25,
+    abs_slack: int = 64 * 1024,
+) -> list[str]:
+    """Gates an inventory against a committed budget (``COLLECTIVES.json``).
+
+    The graftcheck Tier-B contract: per-kind and total payload bytes must
+    stay within ``budget * (1 + rel_tol) + abs_slack``, and a kind that the
+    budget says is absent may not appear beyond the absolute slack — an
+    accidental table-sized all-gather shows up as a new kind or a byte
+    blowup long before hardware. Returns human-readable violations (empty ⇒
+    within budget). Shrinking below budget never fails: regressions in the
+    good direction just mean the budget file deserves a refresh.
+    """
+    problems: list[str] = []
+
+    def limit(b: int) -> float:
+        return b * (1.0 + rel_tol) + abs_slack
+
+    for kind in COLLECTIVE_KINDS:
+        have = inventory.get(kind, {}).get("bytes", 0)
+        want = budget.get(kind, {}).get("bytes", 0)
+        if have > limit(want):
+            problems.append(
+                f"{kind}: {have} payload bytes exceeds budget {want} "
+                f"(+{rel_tol:.0%} + {abs_slack}B slack)"
+            )
+    have_total = inventory.get("total_bytes", 0)
+    want_total = budget.get("total_bytes", 0)
+    if have_total > limit(want_total):
+        problems.append(
+            f"total collective payload {have_total}B exceeds budget {want_total}B "
+            f"(+{rel_tol:.0%} + {abs_slack}B slack)"
+        )
+    return problems
 
 
 def audit_step(jitted_fn, *args, **kwargs):

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""graftcheck: the repo's static-analysis gate (AST lint + program invariants).
+
+Tier A (default, milliseconds, no jax import) lints the package for TPU
+footguns (rules GC001-GC005; ``eventstreamgpt_tpu/analysis/lint.py``),
+suppressing pre-existing findings via ``eventstreamgpt_tpu/analysis/
+baseline.json``. Tier B AOT-lowers the canonical pretrain / fine-tune /
+generation step programs on an 8-device virtual CPU mesh and gates static
+program invariants: f64-free, host-transfer-free, collective payload within
+tolerance of ``COLLECTIVES.json``.
+
+Usage:
+    python scripts/graftcheck.py                 # Tier A over the repo
+    python scripts/graftcheck.py --tier all      # what CI runs
+    python scripts/graftcheck.py --write-baseline  # re-key the baseline
+    python scripts/graftcheck.py --list-rules
+    python scripts/graftcheck.py path/to/file.py # lint specific files
+
+Exit codes: 0 clean, 1 new lint findings, 2 program-invariant violations.
+See docs/analysis.md for the rule catalog and baseline workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+BASELINE_FP = REPO_ROOT / "eventstreamgpt_tpu" / "analysis" / "baseline.json"
+
+
+def run_tier_a(paths: list[Path], write_baseline: bool, no_baseline: bool) -> int:
+    from eventstreamgpt_tpu.analysis.lint import (
+        RULES,
+        apply_baseline,
+        default_targets,
+        lint_paths,
+        load_baseline,
+        save_baseline,
+    )
+
+    targets = paths or default_targets(REPO_ROOT)
+    findings = lint_paths(targets, REPO_ROOT)
+
+    if write_baseline:
+        save_baseline(findings, BASELINE_FP)
+        print(f"graftcheck[A]: wrote {len(findings)} finding(s) to {BASELINE_FP}")
+        return 0
+
+    baseline = {} if no_baseline else load_baseline(BASELINE_FP)
+    new, suppressed = apply_baseline(findings, baseline)
+    print(
+        f"graftcheck[A]: {len(targets)} file(s), {len(findings)} finding(s), "
+        f"{suppressed} baselined, {len(new)} new"
+    )
+    for f in new:
+        print(f.render())
+    if new:
+        counts: dict[str, int] = {}
+        for f in new:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        summary = ", ".join(f"{r} ({RULES[r]}): {n}" for r, n in sorted(counts.items()))
+        print(f"graftcheck[A]: FAIL — {summary}")
+        return 1
+    print("graftcheck[A]: OK")
+    return 0
+
+
+def run_tier_b(rel_tol: float, skip_compile: bool) -> int:
+    # The virtual CPU mesh must exist before the jax backend initializes.
+    from __graft_entry__ import _provision_cpu_devices
+
+    _provision_cpu_devices(8)
+
+    from eventstreamgpt_tpu.analysis.program_checks import run_program_checks
+
+    problems = run_program_checks(
+        rel_tol=rel_tol, compile_collectives=not skip_compile
+    )
+    for p in problems:
+        print(f"graftcheck[B]: {p}")
+    if problems:
+        print(f"graftcheck[B]: FAIL — {len(problems)} violation(s)")
+        return 2
+    gates = "f64-free, host-transfer-free" + (
+        ", collectives budget SKIPPED (--skip-compile)"
+        if skip_compile
+        else ", collectives within budget"
+    )
+    print(f"graftcheck[B]: OK ({gates})")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--tier",
+        choices=("a", "b", "all"),
+        default="a",
+        help="a: AST lint (default, fast); b: lowered-program gates; all: both (CI)",
+    )
+    ap.add_argument("paths", nargs="*", type=Path, help="lint these files only (Tier A)")
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="re-key analysis/baseline.json from the current findings and exit",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true", help="report all findings, ignore the baseline"
+    )
+    ap.add_argument(
+        "--tolerance",
+        type=float,
+        default=0.25,
+        help="relative slack on the COLLECTIVES.json byte budget (default 0.25)",
+    )
+    ap.add_argument(
+        "--skip-compile",
+        action="store_true",
+        help="Tier B: only the fast lowered-text gates, skip the compiled collective audit",
+    )
+    ap.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    args = ap.parse_args(argv)
+
+    if args.write_baseline and args.paths:
+        # A partial lint must never overwrite the whole-repo baseline: the
+        # next full run would report every other pre-existing finding as new.
+        ap.error("--write-baseline re-keys the full-repo baseline; it cannot be combined with explicit paths")
+    if args.write_baseline and args.tier != "a":
+        ap.error("--write-baseline is a Tier A operation; drop --tier (or pass --tier a)")
+
+    if args.list_rules:
+        from eventstreamgpt_tpu.analysis.lint import RULES
+
+        for rule, desc in RULES.items():
+            print(f"{rule}: {desc}")
+        return 0
+
+    rc = 0
+    if args.tier in ("a", "all"):
+        rc = run_tier_a(args.paths, args.write_baseline, args.no_baseline)
+        if args.write_baseline:
+            return rc
+    if rc == 0 and args.tier in ("b", "all"):
+        rc = run_tier_b(args.tolerance, args.skip_compile)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

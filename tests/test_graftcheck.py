@@ -1,0 +1,621 @@
+"""graftcheck: the static-analysis suite's own contract tests.
+
+Three layers, matching the tool's tiers:
+
+* **Rule fixtures** — for each lint rule GC001-GC005, a snippet that
+  deliberately violates it (true positive: the finding fires with the right
+  rule id and line) and an idiomatic repo pattern (false-positive guard:
+  the rule stays silent on code we actually write).
+* **Workflow** — the baseline file suppresses known findings but fails new
+  ones; inline ``graftcheck: allow`` waivers; the repo itself lints clean
+  under the checked-in baseline; the CLI exit-code contract.
+* **Tier B** — the f64 / host-transfer detectors and the collective budget
+  comparator on crafted program text, plus the real no-f64 / no-host-
+  transfer gates on the *lowered* canonical pretrain and fine-tune steps
+  (lowering only — the compiled collective audit runs in the CI
+  ``graftcheck`` job via ``scripts/graftcheck.py --tier all``). The no-f64
+  lowering test is the regression pin for the host-only scope of the
+  ``np.float64`` preprocessing code.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from eventstreamgpt_tpu.analysis.lint import (
+    RULES,
+    apply_baseline,
+    default_targets,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    save_baseline,
+)
+
+pytestmark = pytest.mark.graftcheck
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def rules_on(src: str, path: str = "fixture.py") -> list[tuple[str, int]]:
+    return [(f.rule, f.line) for f in lint_source(textwrap.dedent(src), path)]
+
+
+def rule_ids(src: str, path: str = "fixture.py") -> set[str]:
+    return {r for r, _ in rules_on(src, path)}
+
+
+# ------------------------------------------------------------ GC001 fixtures
+class TestGC001HostSync:
+    def test_float_in_jitted_fn_fires(self):
+        src = """
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return float(jnp.sum(x))
+        """
+        assert ("GC001", 6) in rules_on(src)
+
+    def test_item_in_factory_returned_step_fires(self):
+        # The repo idiom: a factory's nested step fn jitted by a caller.
+        src = """
+        import jax
+
+        def make_body(model):
+            def train_step(state, batch):
+                return state.loss.item()
+            return train_step
+
+        def make_step(model):
+            return jax.jit(make_body(model), donate_argnums=(0,))
+        """
+        assert "GC001" in rule_ids(src)
+
+    def test_np_asarray_in_scan_body_fires(self):
+        src = """
+        import jax
+        import numpy as np
+
+        def outer(xs):
+            def body(c, x):
+                return c, np.asarray(x)
+            return jax.lax.scan(body, 0, xs)
+        """
+        assert "GC001" in rule_ids(src)
+
+    def test_sync_in_dispatch_loop_fires(self):
+        src = """
+        import jax
+
+        def fit(model, batches):
+            step = jax.jit(model)
+            losses = []
+            for b in batches:
+                state, loss = step(b)
+                losses.append(float(loss))
+            return losses
+        """
+        assert ("GC001", 9) in rules_on(src)
+
+    def test_sync_via_loop_helper_fires(self):
+        # handle_window-style: the sync hides in a nested helper the loop calls.
+        src = """
+        import jax
+
+        def fit(step_body, batches):
+            step = jax.jit(step_body)
+
+            def flush(loss):
+                return float(loss)
+
+            out = []
+            for b in batches:
+                loss = step(b)
+                out.append(flush(loss))
+            return out
+        """
+        assert "GC001" in rule_ids(src)
+
+    def test_callback_defined_in_loop_is_clean(self):
+        # A callback *defined* inside the dispatch loop doesn't run per-step
+        # unless called there — only calls are followed.
+        src = """
+        import jax
+
+        def fit(model, batches, logger):
+            step = jax.jit(model)
+            for b in batches:
+                loss = step(b)
+                logger.defer(lambda v=loss: float(v))
+        """
+        assert "GC001" not in rule_ids(src)
+
+    def test_host_loop_without_jit_is_clean(self):
+        src = """
+        def summarize(rows):
+            return [float(r) for r in rows]
+
+        def fit(rows):
+            out = []
+            for r in rows:
+                out.append(float(r))
+            return out
+        """
+        assert "GC001" not in rule_ids(src)
+
+    def test_float_of_literal_in_traced_scope_is_clean(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            best = float("inf")
+            return x * float(2)
+        """
+        assert "GC001" not in rule_ids(src)
+
+    def test_inline_waiver_suppresses(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return float(x)  # graftcheck: allow GC001 -- fixture waiver
+        """
+        assert "GC001" not in rule_ids(src)
+
+
+# ------------------------------------------------------------ GC002 fixtures
+class TestGC002Float64:
+    def test_np_float64_attr_fires(self):
+        assert "GC002" in rule_ids("import numpy as np\nx = np.zeros(3, dtype=np.float64)\n")
+
+    def test_astype_string_fires(self):
+        assert "GC002" in rule_ids("import numpy as np\nx = np.zeros(3).astype('float64')\n")
+
+    def test_dtype_string_kwarg_fires(self):
+        assert "GC002" in rule_ids("import numpy as np\nx = np.arange(3, dtype='float64')\n")
+
+    def test_enable_x64_fires(self):
+        assert "GC002" in rule_ids("import jax\njax.config.update('jax_enable_x64', True)\n")
+
+    def test_preprocessing_allowlist_is_clean(self):
+        src = "import numpy as np\nx = np.zeros(3, dtype=np.float64)\n"
+        assert rule_ids(src, "eventstreamgpt_tpu/data/preprocessing/scaler.py") == set()
+        assert rule_ids(src, "eventstreamgpt_tpu/data/dataset_pandas.py") == set()
+        assert rule_ids(src, "eventstreamgpt_tpu/data/synthetic.py") == set()
+
+    def test_float32_is_clean(self):
+        assert "GC002" not in rule_ids(
+            "import numpy as np\nx = np.zeros(3, dtype=np.float32)\n"
+        )
+
+
+# ------------------------------------------------------------ GC003 fixtures
+class TestGC003KeyReuse:
+    def test_straight_line_reuse_fires(self):
+        src = """
+        import jax
+
+        def sample(key):
+            a = jax.random.normal(key, (3,))
+            b = jax.random.uniform(key, (3,))
+            return a + b
+        """
+        assert ("GC003", 6) in rules_on(src)
+
+    def test_loop_reuse_fires(self):
+        src = """
+        import jax
+
+        def noisy(key, n):
+            out = []
+            for _ in range(n):
+                out.append(jax.random.normal(key, (3,)))
+            return out
+        """
+        assert "GC003" in rule_ids(src)
+
+    def test_split_reassign_idiom_is_clean(self):
+        src = """
+        import jax
+
+        def sample(key):
+            key, k1 = jax.random.split(key)
+            a = jax.random.normal(k1, (3,))
+            key, k2 = jax.random.split(key)
+            b = jax.random.uniform(k2, (3,))
+            return a + b
+        """
+        assert "GC003" not in rule_ids(src)
+
+    def test_fold_in_per_iteration_is_clean(self):
+        src = """
+        import jax
+
+        def noisy(key, n):
+            out = []
+            for i in range(n):
+                out.append(jax.random.normal(jax.random.fold_in(key, i), (3,)))
+            return out
+        """
+        assert "GC003" not in rule_ids(src)
+
+    def test_split_elements_are_distinct_keys(self):
+        src = """
+        import jax
+
+        def make_inputs(seed):
+            ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+            q = jax.random.normal(ks[0], (4,))
+            k = jax.random.normal(ks[1], (4,))
+            v = jax.random.normal(ks[2], (4,))
+            return q, k, v
+        """
+        assert "GC003" not in rule_ids(src)
+
+    def test_early_return_branch_is_not_reuse(self):
+        src = """
+        import jax
+
+        def gen(key, fast):
+            if fast:
+                return jax.random.normal(key, (3,))
+            key, sub = jax.random.split(key)
+            return jax.random.normal(sub, (3,))
+        """
+        assert "GC003" not in rule_ids(src)
+
+
+# ------------------------------------------------------------ GC004 fixtures
+class TestGC004TracedControlFlow:
+    def test_if_on_traced_value_fires(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.sum() > 0:
+                return x
+            return -x
+        """
+        assert ("GC004", 6) in rules_on(src)
+
+    def test_while_on_traced_value_fires(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            while x > 0:
+                x = x - 1
+            return x
+        """
+        assert "GC004" in rule_ids(src)
+
+    def test_static_tests_are_clean(self):
+        src = """
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is None:
+                mask = jnp.ones_like(x)
+            if x.ndim == 2:
+                x = x[None]
+            if len(x.shape) > 3:
+                x = x.reshape(-1)
+            if isinstance(mask, tuple):
+                mask = mask[0]
+            return jnp.where(mask > 0, x, 0.0)
+        """
+        assert "GC004" not in rule_ids(src)
+
+    def test_static_argnames_param_is_clean(self):
+        src = """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("interpret",))
+        def f(x, interpret=False):
+            if interpret:
+                return x
+            return -x
+        """
+        assert "GC004" not in rule_ids(src)
+
+    def test_str_annotated_param_is_clean(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def f(x, mode: str = "mean"):
+            if mode == "mean":
+                return x.mean()
+            return x.sum()
+        """
+        assert "GC004" not in rule_ids(src)
+
+
+# ------------------------------------------------------------ GC005 fixtures
+class TestGC005UndonatedTrainStep:
+    def test_jit_of_train_step_without_donation_fires(self):
+        src = """
+        import jax
+
+        def train_step(state, batch):
+            return state
+
+        step = jax.jit(train_step)
+        """
+        assert ("GC005", 7) in rules_on(src)
+
+    def test_decorated_train_step_without_donation_fires(self):
+        src = """
+        import jax
+
+        @jax.jit
+        def train_step(state, batch):
+            return state
+        """
+        assert "GC005" in rule_ids(src)
+
+    def test_donated_train_step_is_clean(self):
+        src = """
+        import jax
+
+        def train_step(state, batch):
+            return state
+
+        step = jax.jit(train_step, donate_argnums=(0,))
+        """
+        assert "GC005" not in rule_ids(src)
+
+    def test_eval_step_without_donation_is_clean(self):
+        # Eval steps don't update state in place; donation is a train-step
+        # contract only.
+        src = """
+        import jax
+
+        def eval_step(params, batch):
+            return params
+
+        step = jax.jit(eval_step)
+        """
+        assert "GC005" not in rule_ids(src)
+
+
+# -------------------------------------------------------------- baseline
+class TestBaselineWorkflow:
+    SRC = textwrap.dedent(
+        """
+        import numpy as np
+        x = np.zeros(3, dtype=np.float64)
+        """
+    )
+
+    def test_round_trip_suppresses_known_and_fails_new(self, tmp_path):
+        findings = lint_source(self.SRC, "mod.py")
+        assert len(findings) == 1
+        fp = tmp_path / "baseline.json"
+        save_baseline(findings, fp)
+        baseline = load_baseline(fp)
+
+        new, suppressed = apply_baseline(lint_source(self.SRC, "mod.py"), baseline)
+        assert new == [] and suppressed == 1
+
+        # A second, new finding is NOT covered by the old baseline.
+        grown = self.SRC + "y = np.ones(3, dtype=np.float64)\n"
+        new, suppressed = apply_baseline(lint_source(grown, "mod.py"), baseline)
+        assert suppressed == 1
+        assert len(new) == 1 and new[0].rule == "GC002"
+
+    def test_baseline_keys_survive_line_drift(self, tmp_path):
+        findings = lint_source(self.SRC, "mod.py")
+        fp = tmp_path / "baseline.json"
+        save_baseline(findings, fp)
+        # Same code, shifted three lines down: still suppressed (keys are
+        # path+rule+snippet, not line numbers).
+        shifted = "#\n#\n#\n" + self.SRC
+        new, suppressed = apply_baseline(
+            lint_source(shifted, "mod.py"), load_baseline(fp)
+        )
+        assert new == [] and suppressed == 1
+
+    def test_repo_lints_clean_under_checked_in_baseline(self):
+        findings = lint_paths(default_targets(REPO_ROOT), REPO_ROOT)
+        baseline = load_baseline(
+            REPO_ROOT / "eventstreamgpt_tpu" / "analysis" / "baseline.json"
+        )
+        new, _ = apply_baseline(findings, baseline)
+        assert new == [], "new lint findings:\n" + "\n".join(f.render() for f in new)
+
+    def test_checked_in_baseline_is_valid_json_with_rule_ids(self):
+        fp = REPO_ROOT / "eventstreamgpt_tpu" / "analysis" / "baseline.json"
+        data = json.loads(fp.read_text())
+        assert data["findings"], "baseline exists but is empty?"
+        assert all(rec["rule"] in RULES for rec in data["findings"])
+
+
+# ------------------------------------------------------------------ CLI
+class TestCLI:
+    def test_exit_zero_on_repo(self):
+        from scripts.graftcheck import main
+
+        assert main([]) == 0
+
+    def test_exit_nonzero_on_violation_file(self, tmp_path, capsys):
+        from scripts.graftcheck import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("import numpy as np\nx = np.zeros(3, dtype=np.float64)\n")
+        rc = main([str(bad)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "GC002" in out and "bad.py:2" in out
+
+    def test_all_five_rules_reported_with_file_line(self, tmp_path, capsys):
+        """One seeded fixture per rule: the CLI exits non-zero and names
+        every violation as file:line + rule id."""
+        from scripts.graftcheck import main
+
+        bad = tmp_path / "five.py"
+        bad.write_text(
+            textwrap.dedent(
+                """
+                import jax
+                import numpy as np
+
+                @jax.jit
+                def traced(x):
+                    return float(x.sum())          # GC001 (line 7)
+
+                table = np.zeros(4, dtype=np.float64)  # GC002 (line 9)
+
+                def sample(key):
+                    a = jax.random.normal(key, (3,))
+                    b = jax.random.uniform(key, (3,))  # GC003 (line 13)
+                    return a + b
+
+                @jax.jit
+                def branchy(x):
+                    if x.sum() > 0:                # GC004 (line 18)
+                        return x
+                    return -x
+
+                def train_step(state, batch):
+                    return state
+
+                step = jax.jit(train_step)         # GC005 (line 25)
+                """
+            )
+        )
+        rc = main([str(bad)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        expected = {"GC001": 7, "GC002": 9, "GC003": 13, "GC004": 18, "GC005": 25}
+        for rule, line in expected.items():
+            assert rule in out, f"{rule} missing from CLI output"
+            assert f"five.py:{line}" in out, f"{rule} not reported at five.py:{line}"
+
+    def test_list_rules(self, capsys):
+        from scripts.graftcheck import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in RULES:
+            assert rule in out
+
+    def test_write_baseline_rejects_explicit_paths(self, tmp_path, capsys):
+        # A partial lint must never overwrite the whole-repo baseline.
+        from scripts.graftcheck import main
+
+        f = tmp_path / "one.py"
+        f.write_text("x = 1\n")
+        with pytest.raises(SystemExit) as exc:
+            main(["--write-baseline", str(f)])
+        assert exc.value.code == 2
+        assert "cannot be combined" in capsys.readouterr().err
+
+
+# ------------------------------------------------------- Tier B detectors
+class TestProgramCheckDetectors:
+    def test_f64_detector(self):
+        from eventstreamgpt_tpu.analysis.program_checks import check_no_f64
+
+        assert check_no_f64("  %x = f64[4,2] parameter(0)") != []
+        assert check_no_f64("  %y = stablehlo.add : tensor<2x3xf64>") != []
+        assert check_no_f64("  %x = f32[4,2] parameter(0)") == []
+        # hex-ish identifiers must not false-positive
+        assert check_no_f64('  metadata={op_name="jit(f)/af64b"}') == []
+
+    def test_host_transfer_detector(self):
+        from eventstreamgpt_tpu.analysis.program_checks import check_no_host_transfers
+
+        assert check_no_host_transfers("  %o = token[] outfeed(%x, %tok)") != []
+        assert (
+            check_no_host_transfers(
+                '  %c = f32[] custom-call(), custom_call_target="xla_python_cpu_callback"'
+            )
+            != []
+        )
+        assert (
+            check_no_host_transfers(
+                '  %c = stablehlo.custom_call @xla_ffi_python_cpu_callback(%x)'
+            )
+            != []
+        )
+        # ordinary compute and LAPACK custom-calls pass
+        assert check_no_host_transfers("  %a = f32[4] add(%x, %y)") == []
+        assert (
+            check_no_host_transfers(
+                '  %c = f32[] custom-call(), custom_call_target="lapack_sgetrf"'
+            )
+            == []
+        )
+
+    def test_collective_budget_comparator(self):
+        from eventstreamgpt_tpu.parallel import compare_inventory
+
+        budget = {
+            "all-reduce": {"bytes": 100_000},
+            "all-gather": {"bytes": 0},
+            "total_bytes": 100_000,
+        }
+        ok = {"all-reduce": {"bytes": 110_000}, "total_bytes": 110_000}
+        assert compare_inventory(ok, budget, rel_tol=0.25) == []
+        # 10x blowup fails both the kind and the total
+        blowup = {"all-reduce": {"bytes": 1_000_000}, "total_bytes": 1_000_000}
+        assert len(compare_inventory(blowup, budget, rel_tol=0.25)) == 2
+        # a table-sized all-gather is a NEW kind beyond slack
+        new_kind = {
+            "all-reduce": {"bytes": 100_000},
+            "all-gather": {"bytes": 50_000_000},
+            "total_bytes": 50_100_000,
+        }
+        problems = compare_inventory(new_kind, budget, rel_tol=0.25)
+        assert any("all-gather" in p for p in problems)
+        # shrinking below budget never fails
+        shrink = {"all-reduce": {"bytes": 10}, "total_bytes": 10}
+        assert compare_inventory(shrink, budget, rel_tol=0.25) == []
+
+
+# --------------------------------------------- Tier B gates on real programs
+class TestLoweredProgramGates:
+    """The no-f64 / no-host-transfer pins on the canonical steps (lowering
+    only — fast). The host-only scope of the np.float64 preprocessing code
+    (data/preprocessing/, dataset_pandas.py) is exactly what keeps these
+    green: f64 lives in pandas fit statistics, never in the lowered step."""
+
+    @pytest.fixture(scope="class")
+    def pretrain_lowered(self):
+        from eventstreamgpt_tpu.analysis.program_checks import canonical_pretrain_step
+
+        fn, args = canonical_pretrain_step(8, 1)
+        return fn.lower(*args).as_text()
+
+    def test_pretrain_step_is_f64_free(self, pretrain_lowered):
+        from eventstreamgpt_tpu.analysis.program_checks import check_no_f64
+
+        assert "f64[" not in pretrain_lowered
+        assert check_no_f64(pretrain_lowered, "pretrain:dp8") == []
+
+    def test_pretrain_step_is_host_transfer_free(self, pretrain_lowered):
+        from eventstreamgpt_tpu.analysis.program_checks import check_no_host_transfers
+
+        assert check_no_host_transfers(pretrain_lowered, "pretrain:dp8") == []
+
+    def test_finetune_step_is_f64_and_host_transfer_free(self):
+        from eventstreamgpt_tpu.analysis.program_checks import (
+            canonical_finetune_step,
+            check_no_f64,
+            check_no_host_transfers,
+        )
+
+        fn, args = canonical_finetune_step(8)
+        text = fn.lower(*args).as_text()
+        assert check_no_f64(text, "finetune:dp8") == []
+        assert check_no_host_transfers(text, "finetune:dp8") == []

@@ -1,0 +1,229 @@
+"""Compile-count contracts: the step functions compile exactly once.
+
+The compile is the unit of TPU throughput loss — a mid-epoch retrace means
+training at compile speed. These tests pin the contract statically-ish via
+``analysis/compile_guard.CompileGuard``:
+
+* the pretrain train step compiles exactly once across epoch boundaries and
+  a mid-epoch resume (``skip_batches``) on the virtual mesh — dataset batch
+  shapes are static by construction (training drops the short remainder),
+  so a second executable is always a bug;
+* the fine-tuning step likewise;
+* the guard itself detects a shape-drift recompile and raises
+  `RecompileError`;
+* the ``train()`` driver wiring (armed from the second epoch, checked per
+  dispatch) runs a multi-epoch fit + preemption resume without tripping —
+  and with ``guard_recompiles`` the default, every other e2e suite keeps
+  re-proving it.
+
+Self-contained on the synthetic dataset (no /root/reference dependency).
+"""
+
+import shutil
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.analysis.compile_guard import CompileGuard, RecompileError
+from eventstreamgpt_tpu.data import JaxDataset, PytorchDatasetConfig
+from eventstreamgpt_tpu.models.config import (
+    MetricsConfig,
+    OptimizationConfig,
+    StructuredTransformerConfig,
+)
+from eventstreamgpt_tpu.training import (
+    TrainState,
+    build_model,
+    build_optimizer,
+    make_train_step,
+)
+
+pytestmark = pytest.mark.graftcheck
+
+MODEL_KWARGS = dict(
+    hidden_size=32,
+    head_dim=8,
+    num_attention_heads=4,
+    num_hidden_layers=2,
+    intermediate_size=32,
+    TTE_generation_layer_type="log_normal_mixture",
+    TTE_lognormal_generation_num_components=2,
+)
+
+BSZ = 4
+
+
+@pytest.fixture(scope="module")
+def synth_dir(tmp_path_factory):
+    from eventstreamgpt_tpu.data.synthetic import write_synthetic_dataset
+
+    dst = tmp_path_factory.mktemp("synth_ds_compile_guard")
+    write_synthetic_dataset(
+        dst,
+        n_subjects_per_split={"train": 24, "tuning": 8, "held_out": 8},
+        n_event_types=8,
+        n_labs=32,
+        n_meds=8,
+        mean_seq_len=8,
+        max_seq_len=16,
+        seed=0,
+    )
+    return dst
+
+
+@pytest.fixture(scope="module")
+def setup(synth_dir):
+    ds = JaxDataset(
+        PytorchDatasetConfig(save_dir=synth_dir, max_seq_len=8, min_seq_len=2), "train"
+    )
+    config = StructuredTransformerConfig(**MODEL_KWARGS)
+    config.set_to_dataset(ds)
+    oc = OptimizationConfig(init_lr=1e-3, batch_size=BSZ, max_epochs=1)
+    oc.set_to_dataset(ds)
+    model = build_model(config)
+    tx, _ = build_optimizer(oc)
+    init_batch = next(ds.batches(BSZ, shuffle=True, seed=0))
+    params_host = jax.device_get(model.init(jax.random.PRNGKey(0), init_batch))
+
+    def fresh_state():
+        params = jax.tree_util.tree_map(jnp.asarray, params_host)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params)
+        )
+
+    return ds, model, tx, fresh_state
+
+
+class TestCompileGuardUnit:
+    def test_watch_counts_new_executables(self):
+        f = jax.jit(lambda x: x * 2)
+        guard = CompileGuard(watch=[f], max_compiles=0).arm()
+        assert guard.compiles == 0
+        f(jnp.ones(3))
+        assert guard.compiles == 1
+        with pytest.raises(RecompileError):
+            guard.check()
+
+    def test_no_compiles_within_budget(self):
+        f = jax.jit(lambda x: x * 3)
+        f(jnp.ones(3))  # warm
+        with CompileGuard(watch=[f], label="steady region"):
+            for _ in range(3):
+                f(jnp.ones(3))  # cached — guard exits clean
+
+    def test_warn_mode_warns_instead_of_raising(self):
+        f = jax.jit(lambda x: x * 5)
+        guard = CompileGuard(watch=[f], on_violation="warn").arm()
+        f(jnp.ones(3))
+        with pytest.warns(RuntimeWarning, match="new compile"):
+            guard.check()
+        # re-baselined after the warning: a second check is quiet
+        guard.check()
+
+    def test_global_fallback_counts_process_compiles(self):
+        guard = CompileGuard(label="global window").arm()
+        assert guard._use_global
+        jax.jit(lambda x: x - 7)(jnp.ones(3))
+        assert guard.compiles >= 1
+
+
+class TestStepCompilesExactlyOnce:
+    def test_pretrain_step_across_epochs_and_resume(self, setup):
+        ds, model, tx, fresh_state = setup
+        step = make_train_step(model, tx)
+        rng = jax.random.PRNGKey(7)
+        guard = CompileGuard(watch=[step], max_compiles=1, label="pretrain step").arm()
+
+        state = fresh_state()
+        # Epoch 0 (compiles once on the first batch), epoch 1 (same static
+        # shapes — fully cached).
+        for epoch in range(2):
+            for batch in ds.batches(BSZ, shuffle=True, seed=10 + epoch):
+                state, loss = step(state, batch, rng)
+        # Mid-epoch resume: re-derive epoch 1's stream, skip the first batch.
+        for batch in ds.batches(BSZ, shuffle=True, seed=11, skip_batches=1):
+            state, loss = step(state, batch, rng)
+
+        assert np.isfinite(float(loss))
+        assert guard.compiles == 1, f"expected exactly 1 compile, saw {guard.compiles}"
+        guard.check()  # within the max_compiles=1 budget
+
+    def test_finetune_step_across_epochs(self):
+        from eventstreamgpt_tpu.analysis.program_checks import canonical_finetune_step
+
+        step, (state, batch, rng) = canonical_finetune_step(8)
+        guard = CompileGuard(watch=[step], max_compiles=1, label="finetune step").arm()
+        for _ in range(3):  # same shapes: epochs are replays
+            state, loss = step(state, batch, rng)
+        assert np.isfinite(float(loss))
+        assert guard.compiles == 1, f"expected exactly 1 compile, saw {guard.compiles}"
+        guard.check()
+
+    def test_guard_catches_shape_drift(self, setup):
+        ds, model, tx, fresh_state = setup
+        step = make_train_step(model, tx)
+        rng = jax.random.PRNGKey(7)
+        state = fresh_state()
+        batch = next(ds.batches(BSZ, shuffle=True, seed=3))
+        state, _ = step(state, batch, rng)  # warm-up compile
+
+        guard = CompileGuard(watch=[step], label="steady state").arm()
+        # a drifted batch shape (shorter sequence axis) forces a retrace
+        drifted = jax.tree_util.tree_map(
+            lambda x: x[:, :4] if getattr(x, "ndim", 0) >= 2 else x,
+            next(ds.batches(BSZ, shuffle=True, seed=4)),
+        )
+        state, _ = step(state, drifted, rng)
+        with pytest.raises(RecompileError, match="recompiled"):
+            guard.check()
+
+
+@pytest.mark.slow
+class TestDriverWiring:
+    """`train()` with the default ``guard_recompiles=True``: multi-epoch fit
+    and preemption resume must never trip the sentinel (epoch ≥ 2 dispatches
+    are all cached), and the guard must actually be armed on later epochs."""
+
+    def _cfg(self, synth_dir, save_root, **trainer_overrides):
+        from eventstreamgpt_tpu.training.pretrain import PretrainConfig
+
+        trainer = {"log_every_n_steps": 2, "checkpoint_every_n_steps": 4}
+        trainer.update(trainer_overrides)
+        return PretrainConfig(
+            seed=1,
+            config=dict(MODEL_KWARGS),
+            optimization_config=OptimizationConfig(
+                init_lr=1e-3,
+                max_epochs=3,
+                batch_size=BSZ,
+                validation_batch_size=BSZ,
+                lr_frac_warmup_steps=0.5,
+                patience=None,
+            ),
+            data_config=PytorchDatasetConfig(
+                save_dir=synth_dir, max_seq_len=8, min_seq_len=2
+            ),
+            pretraining_metrics_config=MetricsConfig(do_skip_all_metrics=True),
+            final_validation_metrics_config=MetricsConfig(do_skip_all_metrics=True),
+            experiment_dir=str(save_root),
+            save_dir=str(save_root / "pretrain"),
+            trainer_config=trainer,
+        )
+
+    def test_multi_epoch_fit_and_resume_stay_cached(self, synth_dir, tmp_path):
+        from eventstreamgpt_tpu.training.pretrain import train
+
+        cfg = self._cfg(synth_dir, tmp_path)
+        loss, _, _ = train(cfg)  # 3 epochs; guard armed on epochs 2-3
+        assert loss is not None and np.isfinite(loss)
+
+        # Preemption resume: wipe nothing, just run again — resumes from the
+        # last checkpoint into later epochs with the guard active from the
+        # second in-process epoch.
+        cfg2 = self._cfg(synth_dir, tmp_path)
+        cfg2.optimization_config.max_epochs = 5
+        loss2, _, _ = train(cfg2)
+        assert loss2 is not None and np.isfinite(loss2)
