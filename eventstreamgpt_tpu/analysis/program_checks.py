@@ -80,10 +80,13 @@ def _require_devices(n: int) -> None:
 
 
 # ----------------------------------------------------------- canonical steps
-def canonical_pretrain_step(n_data: int, n_model: int):
+def canonical_pretrain_step(n_data: int, n_model: int, with_health: bool = False):
     """The production pretrain train step on a ``data×model`` mesh — the
     exact construction ``dryrun_multichip`` audits into ``COLLECTIVES.json``
-    (same tiny shapes, so inventories are directly comparable)."""
+    (same tiny shapes, so inventories are directly comparable).
+    ``with_health`` builds the divergence-sentinel-instrumented variant,
+    which is what ``train()`` jits by default since the reliability
+    subsystem landed (sentinel_enabled defaults to true)."""
     import jax
     import jax.numpy as jnp
 
@@ -107,12 +110,14 @@ def canonical_pretrain_step(n_data: int, n_model: int):
     state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
     state = shard_state(state, mesh)
     batch = shard_batch(batch, mesh)
-    step = make_train_step(model, tx)
+    step = make_train_step(model, tx, with_health=with_health)
     return step, (state, batch, jax.random.PRNGKey(0))
 
 
-def canonical_finetune_step(n_data: int = 8):
-    """The fine-tuning (stream classification) train step, data-parallel."""
+def canonical_finetune_step(n_data: int = 8, with_health: bool = False):
+    """The fine-tuning (stream classification) train step, data-parallel.
+    ``with_health``: the sentinel-instrumented production default (see
+    `canonical_pretrain_step`)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -151,7 +156,7 @@ def canonical_finetune_step(n_data: int = 8):
     state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
     state = shard_state(state, mesh)
     batch = shard_batch(batch, mesh)
-    step = make_train_step(model, tx)
+    step = make_train_step(model, tx, with_health=with_health)
     return step, (state, batch, jax.random.PRNGKey(0))
 
 
@@ -245,7 +250,15 @@ def run_program_checks(
     programs: dict[str, tuple] = {}
     for name, (n_data, n_model) in layouts.items():
         programs[f"pretrain:{name}"] = canonical_pretrain_step(n_data, n_model)
+    # The sentinel-instrumented variants are the PRODUCTION default (train()
+    # jits with_health=True unless sentinel_enabled is false), so they must
+    # pass the same static gates as the bare step — and the dp8 health
+    # variant is additionally held to the bare dp8 collective budget below:
+    # the divergence sentinel's contract is that it adds no collectives and
+    # no host traffic to the step.
+    programs["pretrain:dp8_health"] = canonical_pretrain_step(8, 1, with_health=True)
     programs["finetune:dp8"] = canonical_finetune_step(8)
+    programs["finetune:dp8_health"] = canonical_finetune_step(8, with_health=True)
     programs["generation:ci"] = canonical_generation_program()
 
     lowered = {}
@@ -257,8 +270,11 @@ def run_program_checks(
         problems += check_no_host_transfers(text, label)
 
     if compile_collectives:
-        for name in layouts:
-            label = f"pretrain:{name}"
+        # label -> COLLECTIVES.json budget key; the health variant reuses the
+        # bare dp8 budget (the sentinel must live within it).
+        budget_keys = {f"pretrain:{name}": name for name in layouts}
+        budget_keys["pretrain:dp8_health"] = "dp8"
+        for label, budget_key in budget_keys.items():
             log(f"compiling {label} for the collective budget gate")
             compiled = lowered[label].compile()
             text = compiled.as_text()
@@ -269,5 +285,5 @@ def run_program_checks(
                 f"{label}: {inv['total_count']} collectives, "
                 f"{inv['total_bytes']} payload bytes"
             )
-            problems += check_collective_budget(inv, name, budget_path, rel_tol)
+            problems += check_collective_budget(inv, budget_key, budget_path, rel_tol)
     return problems

@@ -107,7 +107,7 @@ class DevicePrefetcher:
             return item
         raise StopIteration
 
-    def close(self) -> None:
+    def close(self, join_timeout: float = 5.0) -> None:
         self._stop.set()
         if getattr(self, "_queue", None) is None:
             return
@@ -117,9 +117,27 @@ class DevicePrefetcher:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
+        # Join the worker (bounded): teardown must not leave a thread racing
+        # a live device_put against e.g. pytest's fixture cleanup or the
+        # preemption drain. The worker polls the stop flag every 0.1s, so a
+        # healthy thread exits well inside the timeout; a wedged device_put
+        # is abandoned as a daemon rather than hanging the process.
+        t = getattr(self, "_thread", None)
+        if t is not None and t is not threading.current_thread() and t.is_alive():
+            t.join(timeout=join_timeout)
+        # The worker may have completed one last put() between the first
+        # drain and its stop-flag check — including the case where it
+        # already exited before the liveness check above — so the final
+        # drain is unconditional: no device buffers may linger in the dead
+        # queue.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
 
     def __del__(self):
-        self.close()
+        self.close(join_timeout=1.0)
 
 
 def prefetch_to_device(

@@ -1,0 +1,60 @@
+"""Fault tolerance for long training runs (SURVEY §5.3/§5.4 hardening).
+
+At pod scale faults are the steady state: preempted workers, flaky
+persistent storage, and the occasional divergent step are routine in long
+TPU runs. This package makes every one of them a *recoverable* event with a
+deterministic test harness, instead of a dead or silently poisoned run:
+
+* `sentinel` — divergence detection (non-finite loss/grad-norm, loss-EMA
+  spikes) from device-resident health flags inspected only at the training
+  loops' existing flush cadence, plus the bounded rollback state machine.
+* `integrity` — checkpoint save/restore hardening: exponential-backoff
+  retries for transient ``OSError``s, a checksum manifest sidecar verified
+  on restore, and walk-back to the newest verifiable step when the latest
+  checkpoint is corrupt or unreadable.
+* `preemption` — SIGTERM/SIGINT drain-and-checkpoint with a distinct exit
+  code orchestrators can treat as "reschedule me".
+* `faults` — a deterministic fault-injection plan so every recovery path
+  above is exercised on CPU in CI.
+
+See ``docs/reliability.md`` for the operator-facing contract.
+"""
+
+from .faults import (
+    Fault,
+    FaultPlan,
+    active_fault_plan,
+    clear_fault_plan,
+    corrupt_checkpoint_step,
+    fault_plan,
+    install_fault_plan,
+)
+from .integrity import ReliableCheckpointManager, retry_transient
+from .preemption import EXIT_PREEMPTED, GracefulShutdown, Preempted
+from .sentinel import (
+    DivergenceError,
+    DivergenceSentinel,
+    RollbackController,
+    SentinelConfig,
+    rollback_restore,
+)
+
+__all__ = [
+    "EXIT_PREEMPTED",
+    "DivergenceError",
+    "DivergenceSentinel",
+    "Fault",
+    "FaultPlan",
+    "GracefulShutdown",
+    "Preempted",
+    "ReliableCheckpointManager",
+    "RollbackController",
+    "SentinelConfig",
+    "active_fault_plan",
+    "clear_fault_plan",
+    "corrupt_checkpoint_step",
+    "fault_plan",
+    "install_fault_plan",
+    "retry_transient",
+    "rollback_restore",
+]

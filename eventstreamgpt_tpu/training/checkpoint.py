@@ -15,12 +15,15 @@ restores latest on restart — what TPU-pod preemption requires).
 from __future__ import annotations
 
 import json
+import warnings
 from pathlib import Path
 from typing import Any
 
+import jax
 import orbax.checkpoint as ocp
 
 from ..models.config import StructuredTransformerConfig
+from ..utils.misc import atomic_write_json
 
 PRETRAINED_WEIGHTS_DIR = "pretrained_weights"
 
@@ -93,26 +96,49 @@ class TrainCheckpointManager:
         # is (re)written even when the array save was skipped because the step
         # already exists — e.g. the epoch-end save landing on the same step as
         # an in-loop save must still upgrade the metadata to epoch_complete.
-        if metadata is not None and (saved or step in self._mgr.all_steps()):
-            with open(self.ckpt_dir / f"metadata_{step}.json", "w") as f:
-                json.dump(metadata, f)
+        if (
+            metadata is not None
+            and jax.process_index() == 0
+            and (saved or step in self._mgr.all_steps())
+        ):
+            # Atomic publish (tmp + fsync + rename): a kill mid-write must
+            # never leave a truncated sidecar that poisons the next resume.
+            # Sidecars live on shared storage, so only process 0 writes them
+            # (every process would write identical bytes; racing renames and
+            # prunes are pure downside).
+            atomic_write_json(self.ckpt_dir / f"metadata_{step}.json", metadata)
         if saved:
             self._prune_metadata()
         return saved
 
     def _prune_metadata(self) -> None:
-        """Drops metadata sidecars whose checkpoint the manager has deleted."""
+        """Drops sidecars (metadata, integrity manifests, stranded tmp
+        files) whose checkpoint the manager has deleted. Process 0 only —
+        sidecar files are shared across a pod."""
+        if jax.process_index() != 0:
+            return
         live = set(self._mgr.all_steps())
-        for fp in self.ckpt_dir.glob("metadata_*.json"):
-            try:
-                step = int(fp.stem.split("_")[-1])
-            except ValueError:
-                continue
-            if step not in live:
+        for pattern in ("metadata_*.json", "manifest_*.json"):
+            for fp in self.ckpt_dir.glob(pattern):
+                try:
+                    step = int(fp.stem.split("_")[-1])
+                except ValueError:
+                    continue
+                if step not in live:
+                    fp.unlink(missing_ok=True)
+        # Stranded tmps from killed writers (both the legacy fixed name and
+        # the per-pid unique names). Only process 0 ever writes sidecars, so
+        # no live writer's tmp can be swept here.
+        for pattern in ("*.json.tmp", "*.json.*.tmp"):
+            for fp in self.ckpt_dir.glob(pattern):
                 fp.unlink(missing_ok=True)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        """All committed checkpoint steps, ascending."""
+        return sorted(self._mgr.all_steps())
 
     def restore(self, state_template: Any, step: int | None = None) -> tuple[Any, int]:
         """Restores ``(state, step)`` at ``step`` (default: latest)."""
@@ -126,8 +152,20 @@ class TrainCheckpointManager:
     def metadata(self, step: int) -> dict | None:
         fp = self.ckpt_dir / f"metadata_{step}.json"
         if fp.exists():
-            with open(fp) as f:
-                return json.load(f)
+            try:
+                with open(fp) as f:
+                    return json.load(f)
+            except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+                # A sidecar predating the atomic-write fix (or rotted on
+                # disk) must degrade the resume, not crash it: callers treat
+                # None as "no metadata" and fall back to epoch-boundary
+                # semantics.
+                warnings.warn(
+                    f"undecodable checkpoint metadata sidecar {fp}: {e}; ignoring it",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                return None
         return None
 
     def wait_until_finished(self) -> None:

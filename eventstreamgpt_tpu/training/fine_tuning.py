@@ -28,7 +28,6 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 from flax import serialization
 
 from ..data.config import PytorchDatasetConfig
@@ -37,7 +36,7 @@ from ..data.prefetch import prefetch_to_device
 from ..models.config import OptimizationConfig, Split, StructuredTransformerConfig
 from ..models.fine_tuning_model import ESTForStreamClassification
 from ..utils import config_dataclass
-from .checkpoint import TrainCheckpointManager, load_pretrained, save_pretrained
+from .checkpoint import load_pretrained, save_pretrained
 from .metrics import (
     BinaryAccuracy,
     BinaryAUROC,
@@ -51,7 +50,7 @@ from .metrics import (
     MultilabelAveragePrecision,
 )
 from .optimizer import build_optimizer
-from .pretrain import TrainState, data_parallel_mesh, replicate, shard_batch
+from .pretrain import TrainState, data_parallel_mesh, make_train_step, replicate, shard_batch
 
 # ---------------------------------------------------------------- metrics
 class StreamClassificationMetrics:
@@ -177,6 +176,10 @@ class FinetuneConfig:
     config_overrides: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     do_final_validation_on_metrics: bool = True
+    # Auto-resume parity with pretrain: restore the newest verifiable
+    # train-state checkpoint under save_dir and (for a mid-epoch one) skip
+    # the batches already trained on — same key, same semantics.
+    do_resume_from_checkpoint: bool = True
 
     def __post_init__(self):
         if isinstance(self.save_dir, str):
@@ -300,7 +303,12 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     if is_main:
         save_dir.mkdir(parents=True, exist_ok=True)
         config_fp = save_dir / "config.json"
-        if config_fp.exists() and not cfg.do_overwrite:
+        # Same guard semantics as pretrain: resume waives the overwrite check
+        # only when a checkpoint actually exists to resume from.
+        has_resume_target = cfg.do_resume_from_checkpoint and any(
+            p.name.isdigit() for p in (save_dir / "model_checkpoints").glob("*")
+        )
+        if config_fp.exists() and not cfg.do_overwrite and not has_resume_target:
             raise FileExistsError(f"{config_fp} already exists!")
         config.to_json_file(config_fp, do_overwrite=True)
         data_config.to_json_file(save_dir / "data_config.json", do_overwrite=True)
@@ -323,35 +331,61 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
     state = replicate(state, mesh)
 
-    def train_step(state: TrainState, batch, rng):
-        dropout_rng = jax.random.fold_in(rng, state.step)
+    tc = dict(cfg.trainer_config or {})
 
-        def loss_fn(p):
-            return model.apply(p, batch, rngs={"dropout": dropout_rng}).loss
+    # Reliability subsystem (eventstreamgpt_tpu/reliability/): same wiring
+    # as pretrain — hardened checkpoint I/O, divergence sentinel + bounded
+    # rollback, graceful preemption, deterministic fault hooks.
+    from ..reliability import faults
+    from ..reliability.integrity import ReliableCheckpointManager, resume_training_state
+    from ..reliability.preemption import GracefulShutdown
+    from ..reliability.sentinel import (
+        DivergenceSentinel,
+        HealthMonitor,
+        RollbackController,
+        SentinelConfig,
+        finish_epoch,
+    )
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        return (
-            TrainState(
-                step=state.step + 1,
-                params=optax.apply_updates(state.params, updates),
-                opt_state=new_opt,
-            ),
-            loss,
+    sentinel_cfg = SentinelConfig.from_trainer_config(tc)
+    sentinel = DivergenceSentinel(sentinel_cfg) if sentinel_cfg is not None else None
+    rollback_ctl = (
+        RollbackController(
+            sentinel_cfg.max_rollbacks, save_dir / "divergence_diagnostics.json"
         )
+        if sentinel_cfg is not None
+        else None
+    )
+    with_health = sentinel is not None
 
-    train_step = jax.jit(train_step, donate_argnums=(0,))
+    # The step body is pretrain's, verbatim (same fold-in rng, same update
+    # math) — fine-tuning only swaps the model/loss. with_health adds the
+    # sentinel's [loss, grad_norm] device flags to the step outputs.
+    train_step = make_train_step(model, tx, with_health=with_health)
     eval_step = jax.jit(lambda params, batch: model.apply(params, batch))
 
     # Device-resident batches (r05 feed-path redesign): collate on device
     # from ~100-byte plans — stream labels ride along as host arrays — with
     # the host prefetch pipeline as the oversized-cohort fallback. Few-shot
     # fine-tuning cohorts essentially always fit the budget.
+    # device_resident_data=False opts out (config parity with pretrain —
+    # also what batch-level fault injection needs, since plans collate on
+    # device out of reach of the host poisoning hook).
     from ..data.device_dataset import DeviceDataset
 
-    device_train = DeviceDataset.try_create(
-        train_pyd, mesh=mesh, batch_sizes=(oc.batch_size, oc.validation_batch_size)
-    )
+    resident_mode = tc.get("device_resident_data", "auto")
+    if resident_mode is True:
+        # Explicit opt-in fails loudly on unsupported topologies (pretrain
+        # parity) instead of silently falling back to the host path.
+        device_train = DeviceDataset.create(
+            train_pyd, mesh=mesh, batch_sizes=(oc.batch_size, oc.validation_batch_size)
+        )
+    elif resident_mode is False:
+        device_train = None
+    else:
+        device_train = DeviceDataset.try_create(
+            train_pyd, mesh=mesh, batch_sizes=(oc.batch_size, oc.validation_batch_size)
+        )
     _device_eval_cache: dict[int, "DeviceDataset | None"] = {}
 
     def evaluate(params, dataset, split) -> dict[str, float]:
@@ -389,11 +423,15 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
             batch_iter.close()
         return metrics.compute()
 
-    tc = dict(cfg.trainer_config or {})
     log_every = int(tc.get("log_every_n_steps") or 10)
     ckpt_every = int(tc.get("checkpoint_every_n_steps") or 100)
     keep = int(tc.get("max_checkpoints_to_keep") or 2)
-    ckpt_mgr = TrainCheckpointManager(save_dir / "model_checkpoints", max_to_keep=keep)
+    ckpt_mgr = ReliableCheckpointManager(
+        save_dir / "model_checkpoints",
+        max_to_keep=keep,
+        retries=int(tc.get("ckpt_retries", 3)),
+        backoff_base=float(tc.get("ckpt_backoff_base", 0.5)),
+    )
 
     log_fp = save_dir / "train_log.jsonl" if is_main else None
 
@@ -409,95 +447,184 @@ def train(cfg: FinetuneConfig) -> tuple[float | None, dict | None, dict | None]:
     stop = False
     tuning_metrics = None
 
-    for epoch in range(oc.max_epochs):
-        epoch_t0 = time.perf_counter()
-        window_losses = []
-        if device_train is not None:
-            batch_iter = (
-                (b, None)
-                for b in device_train.batches(
-                    oc.batch_size, shuffle=True, seed=cfg.seed + epoch
+    # Auto-resume (pretrain parity): restore the newest verifiable
+    # train-state checkpoint; a mid-epoch one re-enters its epoch and skips
+    # the batches already trained on (batch order is deterministic per
+    # cfg.seed + epoch, so the skip is rng-exact).
+    start_epoch = 0
+    skip_batches = 0
+    if cfg.do_resume_from_checkpoint and ckpt_mgr.latest_step() is not None:
+        # Shared auto-resume (reliability/integrity.py; pretrain parity).
+        state, resumed_step, start_epoch, skip_batches = resume_training_state(
+            ckpt_mgr, state, lambda s: replicate(s, mesh)
+        )
+        global_step = resumed_step
+
+    shutdown = GracefulShutdown()
+    resume_epoch, resume_skip = start_epoch, skip_batches
+    epoch = start_epoch
+    with shutdown:
+        while epoch < oc.max_epochs:
+            epoch_t0 = time.perf_counter()
+            window_losses = []
+            epoch_skip = resume_skip if epoch == resume_epoch else 0
+            if rollback_ctl is not None:
+                epoch_skip = rollback_ctl.epoch_skip(epoch, epoch_skip)
+            epoch_progress = epoch_skip
+            # Shared health buffer + inspection gate (reliability/sentinel.py):
+            # record per step without readback, inspect only at the flush
+            # cadence — no host sync in the dispatch loop (see pretrain).
+            health_mon = HealthMonitor(sentinel)
+            if device_train is not None:
+                batch_iter = (
+                    (b, None)
+                    for b in device_train.batches(
+                        oc.batch_size,
+                        shuffle=True,
+                        seed=cfg.seed + epoch,
+                        skip_batches=epoch_skip,
+                    )
                 )
-            )
-        else:
-            batch_iter = prefetch_to_device(
-                train_pyd.batches(oc.batch_size, shuffle=True, seed=cfg.seed + epoch),
-                lambda b: shard_batch(b, mesh),
-            )
-        # Window records buffer their losses as device arrays and flush at
-        # checkpoint cadence / epoch end — a float() per window here would
-        # stall the dispatch pipeline on a host readback (GC001), exactly
-        # the bug class graftcheck lints for.
-        pending_logs: list[dict] = []
+            else:
+                batch_iter = prefetch_to_device(
+                    faults.wrap_batches(
+                        train_pyd.batches(
+                            oc.batch_size,
+                            shuffle=True,
+                            seed=cfg.seed + epoch,
+                            skip_batches=epoch_skip,
+                        ),
+                        epoch=epoch,
+                        first_index=epoch_skip,
+                    ),
+                    lambda b: shard_batch(b, mesh),
+                )
+            # Window records buffer their losses as device arrays and flush at
+            # checkpoint cadence / epoch end — a float() per window here would
+            # stall the dispatch pipeline on a host readback (GC001), exactly
+            # the bug class graftcheck lints for.
+            pending_logs: list[dict] = []
 
-        def flush_pending() -> None:
-            for rec in pending_logs:
-                rec["train_loss"] = float(jnp.mean(jnp.stack(rec.pop("_losses"))))  # graftcheck: allow GC001 -- flush runs only after the pipeline drains (ckpt/epoch end)
-                rec["lr"] = float(lr_schedule(rec["step"] // accum))  # graftcheck: allow GC001 -- flush runs only after the pipeline drains (ckpt/epoch end)
-                log_record(rec)
-            pending_logs.clear()
+            def flush_pending() -> None:
+                for rec in pending_logs:
+                    rec["train_loss"] = float(jnp.mean(jnp.stack(rec.pop("_losses"))))  # graftcheck: allow GC001 -- flush runs only after the pipeline drains (ckpt/epoch end)
+                    rec["lr"] = float(lr_schedule(rec["step"] // accum))  # graftcheck: allow GC001 -- flush runs only after the pipeline drains (ckpt/epoch end)
+                    log_record(rec)
+                pending_logs.clear()
 
-        try:
-            for batch, _ in batch_iter:
-                state, loss = train_step(state, batch, rng)  # graftcheck: allow GC003 -- step body folds rng with state.step; constant base key is the dropout-stream contract
-                global_step += 1
-                window_losses.append(loss)
-                if global_step % log_every == 0:
-                    pending_logs.append(
-                        {
-                            "split": str(Split.TRAIN),
-                            "epoch": epoch,
-                            "step": global_step,
-                            "_losses": list(window_losses),
-                        }
-                    )
-                    window_losses = []
-                if global_step % ckpt_every == 0:
-                    ckpt_mgr.save(
-                        global_step,
-                        serialization.to_state_dict(jax.device_get(state)),  # graftcheck: allow GC001 -- checkpoint readback, cadence-bounded
-                        metadata={"epoch": epoch, "epoch_complete": False},
-                    )
-                    # device_get drained the pipeline: persisting the window
-                    # records here is sync-free and bounds preemption loss.
-                    flush_pending()
-                if oc.max_training_steps is not None and global_step // accum >= oc.max_training_steps:
-                    stop = True
+            try:
+                for step_in_epoch, (batch, _) in enumerate(batch_iter, start=epoch_skip):
+                    if with_health:
+                        state, (loss, health) = train_step(state, batch, rng)  # graftcheck: allow GC003 -- step body folds rng with state.step; constant base key is the dropout-stream contract
+                        health_mon.record(health)
+                    else:
+                        state, loss = train_step(state, batch, rng)  # graftcheck: allow GC003 -- step body folds rng with state.step; constant base key is the dropout-stream contract
+                    global_step += 1
+                    epoch_progress = step_in_epoch + 1
+                    faults.maybe_sigterm(global_step, shutdown)
+                    window_losses.append(loss)
+                    if global_step % log_every == 0:
+                        pending_logs.append(
+                            {
+                                "split": str(Split.TRAIN),
+                                "epoch": epoch,
+                                "step": global_step,
+                                "_losses": list(window_losses),
+                            }
+                        )
+                        window_losses = []
+                    if global_step % ckpt_every == 0:
+                        # Shared inspect-then-save gate (see pretrain): the
+                        # save commits only when THIS window vetted healthy.
+                        if health_mon.vetted_save(
+                            ckpt_mgr,
+                            global_step,
+                            lambda: serialization.to_state_dict(jax.device_get(state)),  # graftcheck: allow GC001 -- checkpoint readback + sentinel inspection, cadence-bounded
+                            {
+                                "epoch": epoch,
+                                "epoch_complete": False,
+                                "step_in_epoch": epoch_progress,
+                            },
+                            epoch=epoch,
+                            progress=epoch_progress,
+                        ):
+                            # device_get drained the pipeline: persisting the
+                            # window records here is sync-free and bounds
+                            # preemption loss.
+                            flush_pending()
+                    if (
+                        oc.max_training_steps is not None
+                        and global_step // accum >= oc.max_training_steps
+                    ):
+                        stop = True
+                        break
+                    if shutdown.requested:
+                        break
+                    if health_mon.rollback_requested:
+                        break
+            finally:
+                batch_iter.close()
+                # Flush in the finally so a mid-epoch failure still writes the
+                # loss trajectory leading up to it.
+                flush_pending()
+
+            # Post-epoch recovery tail — shared verbatim with pretrain
+            # (reliability/sentinel.py finish_epoch): tail vetting, pending
+            # rollback, or preemption drain (raises Preempted).
+            outcome = finish_epoch(
+                health_mon=health_mon,
+                rollback_ctl=rollback_ctl,
+                ckpt_mgr=ckpt_mgr,
+                shutdown=shutdown,
+                state=state,
+                place_state=lambda s: replicate(s, mesh),
+                log_record=log_record,
+                epoch=epoch,
+                epoch_progress=epoch_progress,
+                global_step=global_step,
+                accum=accum,
+                max_training_steps=oc.max_training_steps,
+                label="fine-tuning",
+            )
+            if outcome.action == "rollback":
+                state = outcome.state
+                global_step = outcome.global_step
+                resume_epoch, resume_skip = outcome.resume_epoch, outcome.resume_skip
+                stop = outcome.stop
+                epoch = resume_epoch
+                continue
+            tail_healthy = outcome.tail_healthy
+
+            tuning_metrics = evaluate(state.params, tuning_pyd, Split.TUNING)
+            tuning_loss = tuning_metrics.get("tuning_loss", float("nan"))
+            log_record(
+                {
+                    "split": str(Split.TUNING),
+                    "epoch": epoch,
+                    "step": global_step,
+                    **tuning_metrics,
+                    "epoch_time_s": time.perf_counter() - epoch_t0,
+                }
+            )
+            print(f"finetune epoch {epoch}: tuning_loss={tuning_loss:.4f}")
+            if tail_healthy:
+                ckpt_mgr.save(
+                    global_step,
+                    serialization.to_state_dict(jax.device_get(state)),  # graftcheck: allow GC001 -- epoch-end checkpoint readback, pipeline already drained by eval
+                    metadata={"epoch": epoch, "epoch_complete": True},
+                )
+
+            if np.isfinite(tuning_loss) and tuning_loss < best_tuning_loss - 1e-12:
+                best_tuning_loss = tuning_loss
+                epochs_since_best = 0
+            else:
+                epochs_since_best += 1
+                if oc.patience is not None and epochs_since_best >= max(oc.patience, 1):
+                    print(f"Early stopping at epoch {epoch} (patience {oc.patience})")
                     break
-        finally:
-            batch_iter.close()
-            # Flush in the finally so a mid-epoch failure still writes the
-            # loss trajectory leading up to it.
-            flush_pending()
-
-        tuning_metrics = evaluate(state.params, tuning_pyd, Split.TUNING)
-        tuning_loss = tuning_metrics.get("tuning_loss", float("nan"))
-        log_record(
-            {
-                "split": str(Split.TUNING),
-                "epoch": epoch,
-                "step": global_step,
-                **tuning_metrics,
-                "epoch_time_s": time.perf_counter() - epoch_t0,
-            }
-        )
-        print(f"finetune epoch {epoch}: tuning_loss={tuning_loss:.4f}")
-        ckpt_mgr.save(
-            global_step,
-            serialization.to_state_dict(jax.device_get(state)),  # graftcheck: allow GC001 -- epoch-end checkpoint readback, pipeline already drained by eval
-            metadata={"epoch": epoch, "epoch_complete": True},
-        )
-
-        if np.isfinite(tuning_loss) and tuning_loss < best_tuning_loss - 1e-12:
-            best_tuning_loss = tuning_loss
-            epochs_since_best = 0
-        else:
-            epochs_since_best += 1
-            if oc.patience is not None and epochs_since_best >= max(oc.patience, 1):
-                print(f"Early stopping at epoch {epoch} (patience {oc.patience})")
+            if stop:
                 break
-        if stop:
-            break
+            epoch += 1
 
     ckpt_mgr.wait_until_finished()
     params_host = jax.device_get(state.params)

@@ -214,13 +214,20 @@ def replicate(tree: Any, mesh: Mesh) -> Any:
 
 
 # ----------------------------------------------------------------- train step
-def _train_step_body(model, tx) -> Callable:
+def _train_step_body(model, tx, with_health: bool = False) -> Callable:
     """The un-jitted ``(state, batch, rng) -> (state, loss)`` step body.
 
     Shared verbatim by the per-batch step (`make_train_step`) and the
     scanned multi-step program (`make_chunked_train_step`), so both paths
     have identical numerics: same per-step dropout rng (``fold_in`` on the
     step counter), same gradient, same optimizer update.
+
+    ``with_health=True`` switches the output to ``(state, (loss, health))``
+    where ``health`` is the divergence sentinel's device-resident flag
+    vector ``[loss, grad_global_norm]`` (f32). It is computed from values
+    the step already has in registers — no extra host traffic, no change to
+    the parameter/loss numerics — and is read back only at the training
+    loop's existing flush cadence (``reliability/sentinel.py``).
     """
 
     def train_step(state: TrainState, batch: EventStreamBatch, rng: jax.Array):
@@ -233,25 +240,29 @@ def _train_step_body(model, tx) -> Callable:
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(step=state.step + 1, params=new_params, opt_state=new_opt_state),
-            loss,
-        )
+        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt_state)
+        if with_health:
+            health = jnp.stack([loss, optax.global_norm(grads)]).astype(jnp.float32)
+            return new_state, (loss, health)
+        return new_state, loss
 
     return train_step
 
 
-def make_train_step(model, tx) -> Callable:
+def make_train_step(model, tx, with_health: bool = False) -> Callable:
     """A jitted ``(state, batch, rng) -> (state, loss)`` step.
 
     Gradients reduce across the ``data`` axis automatically (XLA inserts the
     psum for replicated-param/sharded-batch layouts). The state is donated so
-    parameters update in place on device.
+    parameters update in place on device. ``with_health`` swaps the output
+    for ``(state, (loss, health))`` (see `_train_step_body`).
     """
-    return jax.jit(_train_step_body(model, tx), donate_argnums=(0,))
+    return jax.jit(_train_step_body(model, tx, with_health=with_health), donate_argnums=(0,))
 
 
-def make_chunked_train_step(model, tx, device_data, packed: bool = False) -> Callable:
+def make_chunked_train_step(
+    model, tx, device_data, packed: bool = False, with_health: bool = False
+) -> Callable:
     """A jitted ``(state, arrays, plans, rng) -> (state, losses)`` program
     that runs ``k`` collate+train steps in ONE dispatch.
 
@@ -267,8 +278,10 @@ def make_chunked_train_step(model, tx, device_data, packed: bool = False) -> Cal
     `DeviceDataset.packed_plan_chunks` (``packed=True``); ``arrays`` is
     ``device_data.arrays``. Pretraining ignores per-subject light fields
     (labels, subject ids), which is why the scanned batch carries none.
+    ``with_health`` stacks the per-step sentinel health vectors alongside
+    the losses: the output becomes ``(state, (losses, healths))``.
     """
-    body = _train_step_body(model, tx)
+    body = _train_step_body(model, tx, with_health=with_health)
 
     if packed:
         kern = device_data.packed_kernel()
@@ -292,8 +305,8 @@ def make_chunked_train_step(model, tx, device_data, packed: bool = False) -> Cal
 
     def chunk_step(state: TrainState, arrays: dict, plans: dict, rng: jax.Array):
         def scan_body(st, plan):
-            st, loss = body(st, collate(arrays, plan), rng)
-            return st, loss
+            st, out = body(st, collate(arrays, plan), rng)
+            return st, out
 
         return jax.lax.scan(scan_body, state, plans)
 
@@ -446,6 +459,13 @@ def train(
 
     Returns ``(tuning_loss, tuning_metrics, held_out_metrics)`` when final
     validation runs, else ``(None, None, None)``.
+
+    Fault tolerance (docs/reliability.md): raises
+    ``reliability.Preempted`` after a graceful SIGTERM/SIGINT drain (final
+    mid-epoch checkpoint written; script entry points convert this to
+    ``EXIT_PREEMPTED``), and ``reliability.DivergenceError`` when the
+    divergence sentinel exhausts its rollback budget (diagnostic dump in
+    ``save_dir/divergence_diagnostics.json``).
     """
     np.random.seed(cfg.seed)
     rng = jax.random.PRNGKey(cfg.seed)
@@ -531,7 +551,13 @@ def train(
     if is_main:
         save_dir.mkdir(parents=True, exist_ok=True)
         config_fp = save_dir / "config.json"
-        if config_fp.exists() and not cfg.do_overwrite and not cfg.do_resume_from_checkpoint:
+        # Resume waives the overwrite guard only when there is actually a
+        # checkpoint to resume from — resume-enabled-but-fresh reruns into a
+        # foreign results dir must still fail loudly instead of clobbering.
+        has_resume_target = cfg.do_resume_from_checkpoint and any(
+            p.name.isdigit() for p in (save_dir / "model_checkpoints").glob("*")
+        )
+        if config_fp.exists() and not cfg.do_overwrite and not has_resume_target:
             raise FileExistsError(f"{config_fp} already exists!")
         config.to_json_file(config_fp, do_overwrite=True)
         data_config.to_json_file(save_dir / "data_config.json", do_overwrite=True)
@@ -606,31 +632,54 @@ def train(
     keep = int(tc.get("max_checkpoints_to_keep") or 2)
     profile_dir = tc.get("profile_dir")
 
-    ckpt_mgr = TrainCheckpointManager(
-        save_dir / "model_checkpoints", max_to_keep=keep, save_interval_steps=1
+    # Reliability subsystem (eventstreamgpt_tpu/reliability/): hardened
+    # checkpoint I/O (retry/backoff + checksum manifests + walk-back),
+    # the divergence sentinel with bounded rollback, graceful preemption,
+    # and the deterministic fault hooks CI drives all of it with. Imported
+    # lazily (like CompileGuard) so the module graph stays cycle-free.
+    from ..reliability import faults
+    from ..reliability.integrity import ReliableCheckpointManager, resume_training_state
+    from ..reliability.preemption import GracefulShutdown
+    from ..reliability.sentinel import (
+        DivergenceSentinel,
+        HealthMonitor,
+        RollbackController,
+        SentinelConfig,
+        finish_epoch,
+    )
+
+    sentinel_cfg = SentinelConfig.from_trainer_config(tc)
+    sentinel = DivergenceSentinel(sentinel_cfg) if sentinel_cfg is not None else None
+    rollback_ctl = (
+        RollbackController(
+            sentinel_cfg.max_rollbacks, save_dir / "divergence_diagnostics.json"
+        )
+        if sentinel_cfg is not None
+        else None
+    )
+    with_health = sentinel is not None
+
+    ckpt_mgr = ReliableCheckpointManager(
+        save_dir / "model_checkpoints",
+        max_to_keep=keep,
+        save_interval_steps=1,
+        retries=int(tc.get("ckpt_retries", 3)),
+        backoff_base=float(tc.get("ckpt_backoff_base", 0.5)),
     )
     start_epoch = 0
     skip_batches = 0
     if cfg.do_resume_from_checkpoint and ckpt_mgr.latest_step() is not None:
-        template = serialization.to_state_dict(jax.device_get(state))
-        restored_sd, resumed_step = ckpt_mgr.restore(template)
-        state = serialization.from_state_dict(jax.device_get(state), restored_sd)
-        state = place_state(state)
-        meta = ckpt_mgr.metadata(resumed_step) or {}
-        if meta.get("epoch_complete", True):
-            start_epoch = int(meta.get("epoch", 0)) + 1
-        else:
-            # Mid-epoch (preemption) checkpoint: the epoch's batch order is
-            # deterministic (seeded by cfg.seed + epoch), so re-enter the same
-            # epoch and skip the batches already trained on.
-            start_epoch = int(meta.get("epoch", 0))
-            skip_batches = int(meta.get("step_in_epoch", 0))
-        print(
-            f"Resumed from checkpoint at step {resumed_step} "
-            f"(epoch {start_epoch}, skipping {skip_batches} batches)"
+        # Shared auto-resume (reliability/integrity.py): walk-back restore of
+        # the newest verifiable checkpoint with readable resume metadata — a
+        # corrupt or partially-written latest step degrades the relaunch
+        # instead of crashing it, and a mid-epoch (preemption) checkpoint
+        # re-enters its epoch past the batches already trained on (batch
+        # order is deterministic per cfg.seed + epoch: the skip is rng-exact).
+        state, _, start_epoch, skip_batches = resume_training_state(
+            ckpt_mgr, state, place_state
         )
 
-    train_step = make_train_step(model, tx)
+    train_step = make_train_step(model, tx, with_health=with_health)
     eval_step = make_eval_step(model)
 
     # Device-resident data (round-5 feed-path redesign; data/device_dataset.py):
@@ -676,7 +725,9 @@ def train(
         chunk_steps = max(min(log_every, ckpt_every, 16), 1)
     chunk_steps = int(chunk_steps)
     chunked_step = (
-        make_chunked_train_step(model, tx, device_train, packed=use_packed)
+        make_chunked_train_step(
+            model, tx, device_train, packed=use_packed, with_health=with_health
+        )
         if device_train is not None
         else None
     )
@@ -745,8 +796,14 @@ def train(
     # would leave the full-chunk executable uncompiled until the next epoch —
     # a legitimate compile that must not trip the sentinel.
     full_epoch_completed_in_process = False
-    with ring_cm:
-        for epoch in range(start_epoch, oc.max_epochs):
+    shutdown = GracefulShutdown()
+    # A while-loop, not a range: divergence rollback rewinds the walker —
+    # restoring the last good checkpoint may re-enter the same epoch (or an
+    # earlier one) with a fresh skip point past the poisoned window.
+    resume_epoch, resume_skip = start_epoch, skip_batches
+    epoch = start_epoch
+    with ring_cm, shutdown:
+        while epoch < oc.max_epochs:
             if step_guard is not None:
                 if full_epoch_completed_in_process:
                     step_guard.arm()
@@ -755,7 +812,20 @@ def train(
             epoch_t0 = time.perf_counter()
             window_t0, window_events, window_n = time.perf_counter(), 0, 0
             window_losses: list = []
-            epoch_skip = skip_batches if epoch == start_epoch else 0
+            epoch_skip = resume_skip if epoch == resume_epoch else 0
+            if rollback_ctl is not None:
+                # Excise any window a previous rollback marked poisoned: the
+                # epoch's batch order is deterministic, so a data-caused
+                # fault would simply re-fire if these batches were retrained.
+                epoch_skip = rollback_ctl.epoch_skip(epoch, epoch_skip)
+            epoch_progress = epoch_skip  # epoch-order batches consumed so far
+            preempt_requested = False
+            # The shared health buffer + inspection gate (reliability/
+            # sentinel.py): dispatches `record` their device flags without
+            # readback; `inspect` runs only at the existing flush cadence
+            # (checkpoint saves, epoch end) where the pipeline drains anyway,
+            # so the sentinel adds no host sync to the dispatch loop.
+            health_mon = HealthMonitor(sentinel)
 
             def flush_window() -> dict:
                 """Closes the current logging window into a record whose
@@ -792,28 +862,36 @@ def train(
                 dispatch pipeline on a data-plane round trip every window;
                 GC001).
                 """
-                nonlocal stop
+                nonlocal stop, preempt_requested
                 if global_step % log_every < stepped:
                     pending.append(flush_window())
                 if global_step % ckpt_every < stepped:
-                    ckpt_mgr.save(
+                    # Shared inspect-then-save gate (HealthMonitor.vetted_save):
+                    # sentinel inspection rides the checkpoint cadence and the
+                    # save commits only when THIS window vetted healthy — a
+                    # bad-but-below-streak window must never become a poisoned
+                    # rollback target. Checkpointing IS a host readback; the
+                    # cadence (ckpt_every) bounds how often the pipeline
+                    # drains.
+                    if health_mon.vetted_save(
+                        ckpt_mgr,
                         global_step,
-                        # Checkpointing IS a host readback; the cadence
-                        # (ckpt_every) bounds how often the pipeline drains.
-                        serialization.to_state_dict(jax.device_get(state)),  # graftcheck: allow GC001 -- checkpoint readback, cadence-bounded
-                        metadata={
+                        lambda: serialization.to_state_dict(jax.device_get(state)),  # graftcheck: allow GC001 -- checkpoint readback + sentinel inspection, cadence-bounded
+                        {
                             "epoch": epoch,
                             "epoch_complete": False,
                             "step_in_epoch": step_in_epoch,
                         },
-                    )
-                    # The device_get above already drained the pipeline, so
-                    # persisting the buffered window records here costs no
-                    # extra sync — and bounds what a SIGKILL-style preemption
-                    # can lose from train_log.jsonl to ckpt_every steps.
-                    for rec in pending:
-                        finalize_record(rec)
-                    pending.clear()
+                        epoch=epoch,
+                        progress=step_in_epoch,
+                    ):
+                        # The device_get above already drained the pipeline, so
+                        # persisting the buffered window records here costs no
+                        # extra sync — and bounds what a SIGKILL-style preemption
+                        # can lose from train_log.jsonl to ckpt_every steps.
+                        for rec in pending:
+                            finalize_record(rec)
+                        pending.clear()
                 if step_guard is not None and step_guard.armed:
                     if chunked_step is None or stepped == chunk_steps:
                         # Steady state: the watched step function must not
@@ -832,6 +910,11 @@ def train(
                     and global_step // accum >= oc.max_training_steps
                 ):
                     stop = True
+                if shutdown.requested:
+                    # Graceful preemption: this chunk boundary is the drain
+                    # point; the final checkpoint is written once the
+                    # dispatch loops unwind (reliability/preemption.py).
+                    preempt_requested = True
 
             # Window records buffer device losses and flush once the dispatch
             # loop exits — in a finally, so a mid-epoch failure (step error,
@@ -865,9 +948,15 @@ def train(
                         ):
                             jax.profiler.start_trace(str(profile_dir))
                             profiling = True
-                        state, losses = chunked_step(state, device_train.arrays, plans, rng)  # graftcheck: allow GC003 -- step body folds rng with state.step; constant base key is the dropout-stream contract
+                        if with_health:
+                            state, (losses, healths) = chunked_step(state, device_train.arrays, plans, rng)  # graftcheck: allow GC003 -- step body folds rng with state.step; constant base key is the dropout-stream contract
+                            health_mon.record(healths)
+                        else:
+                            state, losses = chunked_step(state, device_train.arrays, plans, rng)  # graftcheck: allow GC003 -- step body folds rng with state.step; constant base key is the dropout-stream contract
                         global_step += k
                         step_in_epoch += k
+                        epoch_progress = step_in_epoch
+                        faults.maybe_sigterm(global_step, shutdown)
                         window_events += n_events
                         window_losses.append(losses)
                         window_n += k
@@ -875,7 +964,7 @@ def train(
                             jax.profiler.stop_trace()
                             profiling = False
                         handle_window(step_in_epoch, k, pending_logs)
-                        if stop:
+                        if stop or health_mon.rollback_requested or preempt_requested:
                             break
                 else:
                     # Asynchronous host input pipeline: collation + device_put
@@ -885,7 +974,14 @@ def train(
                     # the worker — reading them here would otherwise force a
                     # device sync every step.
                     batch_iter = prefetch_to_device(
-                        train_batches(epoch, epoch_skip),
+                        # Fault injection (reliability/faults.py): a no-op
+                        # pass-through unless a plan scripts a poisoned batch
+                        # for this epoch's deterministic order.
+                        faults.wrap_batches(
+                            train_batches(epoch, epoch_skip),
+                            epoch=epoch,
+                            first_index=epoch_skip,
+                        ),
                         lambda b: place_batch(b, mesh),
                         host_stats_fn=lambda b: int(b.event_mask.sum()),
                     )
@@ -896,8 +992,14 @@ def train(
                             if profile_dir and not profiling and 10 <= global_step < 20:
                                 jax.profiler.start_trace(str(profile_dir))
                                 profiling = True
-                            state, loss = train_step(state, batch, rng)  # graftcheck: allow GC003 -- step body folds rng with state.step; constant base key is the dropout-stream contract
+                            if with_health:
+                                state, (loss, health) = train_step(state, batch, rng)  # graftcheck: allow GC003 -- step body folds rng with state.step; constant base key is the dropout-stream contract
+                                health_mon.record(health)
+                            else:
+                                state, loss = train_step(state, batch, rng)  # graftcheck: allow GC003 -- step body folds rng with state.step; constant base key is the dropout-stream contract
                             global_step += 1
+                            epoch_progress = step_in_epoch + 1
+                            faults.maybe_sigterm(global_step, shutdown)
                             window_events += n_events
                             # Keep the loss on device: converting every step
                             # would sync the host with the device and serialize
@@ -908,18 +1010,48 @@ def train(
                                 jax.profiler.stop_trace()
                                 profiling = False
                             handle_window(step_in_epoch + 1, 1, pending_logs)
-                            if stop:
+                            if stop or health_mon.rollback_requested or preempt_requested:
                                 break
                     finally:
                         batch_iter.close()
             finally:
                 for rec in pending_logs:
                     finalize_record(rec)
-            if epoch_skip == 0:
-                full_epoch_completed_in_process = True
             if profiling:
                 jax.profiler.stop_trace()
                 profiling = False
+
+            # Post-epoch recovery tail (reliability/sentinel.py finish_epoch,
+            # shared verbatim with fine-tuning): vets the tail window,
+            # executes a pending rollback, or drains a pending preemption
+            # (raising Preempted after the tail-gated final checkpoint). The
+            # returned verdict gates the epoch-end checkpoint below.
+            outcome = finish_epoch(
+                health_mon=health_mon,
+                rollback_ctl=rollback_ctl,
+                ckpt_mgr=ckpt_mgr,
+                shutdown=shutdown,
+                state=state,
+                place_state=place_state,
+                log_record=log_record,
+                epoch=epoch,
+                epoch_progress=epoch_progress,
+                global_step=global_step,
+                accum=accum,
+                max_training_steps=oc.max_training_steps,
+                label="pretraining",
+            )
+            if outcome.action == "rollback":
+                state = outcome.state
+                global_step = outcome.global_step
+                resume_epoch, resume_skip = outcome.resume_epoch, outcome.resume_skip
+                stop = outcome.stop
+                epoch = resume_epoch
+                continue
+            tail_healthy = outcome.tail_healthy
+
+            if epoch_skip == 0:
+                full_epoch_completed_in_process = True
 
             # Tuning eval (loss-only under the default pretraining metrics config).
             rng, eval_key = jax.random.split(rng)  # graftcheck: allow GC003 -- train consumptions above only fold_in; this split advances the base stream
@@ -952,11 +1084,12 @@ def train(
                 f" tuning_loss={tuning_loss:.4f}"
             )
 
-            ckpt_mgr.save(
-                global_step,
-                serialization.to_state_dict(jax.device_get(state)),  # graftcheck: allow GC001 -- epoch-end checkpoint readback, pipeline already drained by eval
-                metadata={"epoch": epoch, "epoch_complete": True},
-            )
+            if tail_healthy:
+                ckpt_mgr.save(
+                    global_step,
+                    serialization.to_state_dict(jax.device_get(state)),  # graftcheck: allow GC001 -- epoch-end checkpoint readback, pipeline already drained by eval
+                    metadata={"epoch": epoch, "epoch_complete": True},
+                )
 
             # Early stopping (reference EarlyStopping(monitor="tuning_loss")).
             if np.isfinite(tuning_loss) and tuning_loss < best_tuning_loss - 1e-12:
@@ -971,6 +1104,7 @@ def train(
                     break
             if stop:
                 break
+            epoch += 1
 
     ckpt_mgr.wait_until_finished()
     params_host = jax.device_get(state.params)

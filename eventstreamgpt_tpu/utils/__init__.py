@@ -13,6 +13,7 @@ from .misc import (
     COUNT_OR_PROPORTION,
     SeedableMixin,
     TimeableMixin,
+    atomic_write_json,
     count_or_proportion,
     lt_count_or_proportion,
     num_initial_spaces,
@@ -27,6 +28,7 @@ __all__ = [
     "SeedableMixin",
     "StrEnum",
     "TimeableMixin",
+    "atomic_write_json",
     "config_dataclass",
     "count_or_proportion",
     "load_config",

@@ -8,15 +8,51 @@ package the reference depends on (``SeedableMixin``, ``TimeableMixin``).
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from functools import wraps
+from pathlib import Path
 from typing import Any, Callable, Union
 
 import numpy as np
 
 COUNT_OR_PROPORTION = Union[int, float]
+
+
+def atomic_write_json(fp: Path | str, obj: Any, **json_kwargs: Any) -> None:
+    """Atomically publishes ``obj`` as JSON at ``fp`` (tmp + fsync + rename).
+
+    The one durable-sidecar writer (checkpoint metadata, integrity
+    manifests, divergence diagnostics): a crash mid-write must never leave a
+    truncated JSON file where a reader expects a valid one, and a crash
+    right after must still find the bytes on disk — hence the fsync before
+    the rename. The tmp name is per-process unique so concurrent writers on
+    a shared filesystem (pod-scale multi-host runs) cannot truncate each
+    other's in-flight tmp and publish a torn file through the rename.
+    """
+    fp = Path(fp)
+    tmp = fp.with_name(f"{fp.name}.{os.getpid()}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(obj, f, **json_kwargs)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, fp)
+    # The rename itself lives in the directory entry: without fsyncing the
+    # parent, a power loss can make the just-published file vanish (and a
+    # vanished integrity manifest silently downgrades verification).
+    try:
+        dirfd = os.open(fp.parent, os.O_RDONLY)
+    except OSError:  # platforms/filesystems without directory opens
+        return
+    try:
+        os.fsync(dirfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dirfd)
 
 
 def count_or_proportion(N: int | None, cnt_or_prop: COUNT_OR_PROPORTION) -> int:

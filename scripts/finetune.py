@@ -26,4 +26,11 @@ def main(argv: list[str] | None = None):
 
 
 if __name__ == "__main__":
-    main()
+    from eventstreamgpt_tpu.reliability import EXIT_PREEMPTED, Preempted
+
+    try:
+        main()
+    except Preempted as e:
+        # Same reschedule contract as scripts/pretrain.py (docs/reliability.md).
+        print(f"Preempted cleanly at step {e.step}; exiting {EXIT_PREEMPTED} for reschedule.")
+        sys.exit(EXIT_PREEMPTED)

@@ -44,4 +44,13 @@ def main(argv: list[str] | None = None):
 
 
 if __name__ == "__main__":
-    main()
+    from eventstreamgpt_tpu.reliability import EXIT_PREEMPTED, Preempted
+
+    try:
+        main()
+    except Preempted as e:
+        # The orchestrator contract (docs/reliability.md): a graceful
+        # SIGTERM/SIGINT drain wrote a final mid-epoch checkpoint; exit with
+        # the distinct "reschedule me" status instead of a failure code.
+        print(f"Preempted cleanly at step {e.step}; exiting {EXIT_PREEMPTED} for reschedule.")
+        sys.exit(EXIT_PREEMPTED)

@@ -67,6 +67,42 @@ class TestDevicePrefetcher:
             time.sleep(0.01)
         assert not it._thread.is_alive()
 
+    def test_close_joins_worker_synchronously(self):
+        """close() returns only after the worker thread is joined: teardown
+        (fixture cleanup, preemption drain, pytest exit) must never race a
+        live device_put from a leaked thread."""
+
+        def gen():
+            for i in range(10_000):
+                yield {"x": np.zeros(2)}
+                time.sleep(0.001)  # keep the worker mid-stream at close time
+
+        it = prefetch_to_device(gen(), jax.device_put, depth=2)
+        next(it)
+        assert it._thread.is_alive()
+        it.close()
+        # No polling: the bounded join inside close() already reaped it.
+        assert not it._thread.is_alive()
+        # Idempotent, including after the thread is gone.
+        it.close()
+
+    def test_close_drains_late_put(self):
+        """A put() racing between close()'s drain and the worker's stop-flag
+        check must not strand device buffers in the dead queue."""
+        release = threading.Event()
+
+        def gen():
+            yield {"x": np.zeros(2)}
+            release.wait(timeout=5)  # hold the worker mid-iteration
+            yield {"x": np.ones(2)}
+
+        it = prefetch_to_device(gen(), jax.device_put, depth=2)
+        next(it)
+        release.set()
+        it.close()
+        assert not it._thread.is_alive()
+        assert it._queue.empty()
+
     def test_depth_validation(self):
         with pytest.raises(ValueError, match="depth"):
             DevicePrefetcher([], jax.device_put, depth=0)

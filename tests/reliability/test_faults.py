@@ -1,0 +1,203 @@
+"""Fault-plan unit tests: deterministic triggers, poisoning, disk corruption.
+
+Fast (tier-1) coverage of ``reliability/faults.py``: trigger matching on the
+deterministic counters, one-shot vs re-firing semantics, the host-batch
+poisoning transforms, the save hooks' raise behavior, and the on-disk
+corruption utility the crash-consistency tests build on.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.reliability import faults
+from eventstreamgpt_tpu.reliability.faults import (
+    Fault,
+    FaultPlan,
+    active_fault_plan,
+    clear_fault_plan,
+    corrupt_checkpoint_step,
+    fault_plan,
+    install_fault_plan,
+    wrap_batches,
+)
+
+pytestmark = pytest.mark.reliability
+
+
+@dataclasses.dataclass(frozen=True)
+class FakeBatch:
+    """Minimal stand-in with the poisoned fields + the ``replace`` contract."""
+
+    dynamic_values: np.ndarray
+    time_delta: np.ndarray
+    event_mask: np.ndarray
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+def make_batch(value: float = 1.0) -> FakeBatch:
+    return FakeBatch(
+        dynamic_values=np.full((2, 3, 4), value, np.float32),
+        time_delta=np.full((2, 3), value, np.float32),
+        event_mask=np.ones((2, 3), bool),
+    )
+
+
+class TestFaultSpec:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            Fault(kind="meteor_strike", step=1)
+
+    @pytest.mark.parametrize(
+        "kind,kwargs",
+        [
+            ("nan_batch", {}),
+            ("spike_batch", {}),
+            ("save_error", {}),
+            ("corrupt_checkpoint", {}),
+            ("kill", {}),
+            ("sigterm", {}),
+        ],
+    )
+    def test_missing_trigger_rejected(self, kind, kwargs):
+        with pytest.raises(ValueError):
+            Fault(kind=kind, **kwargs)
+
+
+class TestPlanTriggers:
+    def test_batch_fault_epoch_wildcard_and_pin(self):
+        plan = FaultPlan(
+            [
+                Fault(kind="nan_batch", batch_index=3),  # any epoch
+                Fault(kind="spike_batch", epoch=1, batch_index=5),
+            ]
+        )
+        assert plan.batch_fault(0, 3).kind == "nan_batch"
+        assert plan.batch_fault(7, 3).kind == "nan_batch"
+        assert plan.batch_fault(0, 5) is None
+        assert plan.batch_fault(1, 5).kind == "spike_batch"
+        assert plan.batch_fault(1, 4) is None
+
+    def test_sigterm_is_one_shot(self):
+        plan = FaultPlan([Fault(kind="sigterm", step=4)])
+        assert plan.take_sigterm(3) is None
+        assert plan.take_sigterm(4) is not None
+        # A rollback could rewind the counter past 4 again; preemption must
+        # not re-fire.
+        assert plan.take_sigterm(4) is None
+
+    def test_sigterm_fires_on_chunk_crossing(self):
+        # A scanned chunk advances the counter by k: the first boundary AT or
+        # PAST the scripted step takes the fault.
+        plan = FaultPlan([Fault(kind="sigterm", step=3)])
+        assert plan.take_sigterm(2) is None
+        assert plan.take_sigterm(4) is not None
+        assert plan.take_sigterm(6) is None
+
+    def test_save_fault_matches_call_index(self):
+        plan = FaultPlan([Fault(kind="save_error", save_index=2, times=2)])
+        assert plan.save_fault("save_error", 1) is None
+        assert plan.save_fault("save_error", 2).times == 2
+        assert plan.save_fault("corrupt_checkpoint", 2) is None
+
+
+class TestInstallation:
+    def test_context_manager_installs_and_clears(self):
+        assert active_fault_plan() is None
+        with fault_plan(FaultPlan([Fault(kind="sigterm", step=1)])) as plan:
+            assert active_fault_plan() is plan
+        assert active_fault_plan() is None
+
+    def test_clear_after_install(self):
+        install_fault_plan(FaultPlan([]))
+        assert active_fault_plan() is not None
+        clear_fault_plan()
+        assert active_fault_plan() is None
+
+
+class TestBatchPoisoning:
+    def test_wrap_without_plan_is_passthrough(self):
+        batches = [make_batch(), make_batch()]
+        clear_fault_plan()
+        out = list(wrap_batches(batches, epoch=0, first_index=0))
+        assert out[0] is batches[0] and out[1] is batches[1]
+
+    def test_nan_batch_poisons_only_target_index(self):
+        batches = [make_batch(), make_batch(), make_batch()]
+        with fault_plan(FaultPlan([Fault(kind="nan_batch", batch_index=1)])) as plan:
+            out = list(wrap_batches(batches, epoch=0, first_index=0))
+        assert np.isfinite(out[0].dynamic_values).all()
+        assert np.isnan(out[1].dynamic_values).all()
+        assert np.isnan(out[1].time_delta).all()
+        assert np.isfinite(out[2].dynamic_values).all()
+        # The mask is structural, never poisoned.
+        assert out[1].event_mask.all()
+        assert plan.fired == [{"kind": "nan_batch", "epoch": 0, "batch_index": 1}]
+
+    def test_spike_batch_scales_values(self):
+        with fault_plan(FaultPlan([Fault(kind="spike_batch", batch_index=0, scale=100.0)])):
+            (out,) = list(wrap_batches([make_batch(2.0)], epoch=0, first_index=0))
+        np.testing.assert_allclose(out.dynamic_values, 200.0)
+        np.testing.assert_allclose(out.time_delta, 200.0)
+
+    def test_first_index_keeps_triggers_aligned_after_skip(self):
+        """A resumed stream starting at index 2 must see the index-3 fault on
+        its SECOND batch — and a stream skipped past it must never see it."""
+        fault = Fault(kind="nan_batch", batch_index=3)
+        with fault_plan(FaultPlan([fault])):
+            out = list(wrap_batches([make_batch(), make_batch()], epoch=0, first_index=2))
+            assert np.isfinite(out[0].dynamic_values).all()
+            assert np.isnan(out[1].dynamic_values).all()
+        with fault_plan(FaultPlan([fault])):
+            out = list(wrap_batches([make_batch(), make_batch()], epoch=0, first_index=4))
+            assert all(np.isfinite(b.dynamic_values).all() for b in out)
+
+
+class TestSaveHooks:
+    def test_maybe_fail_save_respects_times(self):
+        with fault_plan(FaultPlan([Fault(kind="save_error", save_index=0, times=2)])) as plan:
+            with pytest.raises(OSError):
+                faults.maybe_fail_save(0, 0)
+            with pytest.raises(OSError):
+                faults.maybe_fail_save(0, 1)
+            faults.maybe_fail_save(0, 2)  # third attempt succeeds
+            faults.maybe_fail_save(1, 0)  # other save calls unaffected
+        assert len(plan.fired) == 2
+
+    def test_no_plan_hooks_are_noops(self):
+        clear_fault_plan()
+        faults.maybe_fail_save(0, 0)
+        faults.maybe_sigterm(123)
+
+
+class TestDiskCorruption:
+    def _make_step(self, tmp_path, step=3):
+        d = tmp_path / str(step)
+        d.mkdir(parents=True)
+        (d / "small.bin").write_bytes(b"x" * 10)
+        (d / "arrays.bin").write_bytes(b"y" * 1000)
+        return d
+
+    def test_truncate_halves_largest_file(self, tmp_path):
+        self._make_step(tmp_path)
+        target = corrupt_checkpoint_step(tmp_path, 3, mode="truncate")
+        assert target.name == "arrays.bin"
+        assert target.stat().st_size == 500
+
+    def test_garbage_rewrites_bytes_same_size(self, tmp_path):
+        self._make_step(tmp_path)
+        target = corrupt_checkpoint_step(tmp_path, 3, mode="garbage")
+        assert target.stat().st_size == 1000
+        assert target.read_bytes()[:4] == b"\xde\xad\xbe\xef"
+
+    def test_missing_step_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            corrupt_checkpoint_step(tmp_path, 99)
+
+    def test_unknown_mode_rejected(self, tmp_path):
+        self._make_step(tmp_path)
+        with pytest.raises(ValueError):
+            corrupt_checkpoint_step(tmp_path, 3, mode="subtle")

@@ -1,0 +1,237 @@
+"""Checkpoint-integrity unit tests: retry/backoff, manifests, walk-back.
+
+Fast (tier-1) coverage of ``reliability/integrity.py`` and the hardened
+metadata sidecars of ``training/checkpoint.py``: exponential backoff on
+transient ``OSError``s, the checksum-manifest write/verify cycle, walk-back
+restore over corrupt and legacy-truncated steps, and tolerant metadata
+decoding.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.reliability import (
+    Fault,
+    FaultPlan,
+    ReliableCheckpointManager,
+    corrupt_checkpoint_step,
+    fault_plan,
+    retry_transient,
+)
+
+pytestmark = pytest.mark.reliability
+
+
+def state_at(k: int) -> dict:
+    return {"step": np.asarray(k), "params": {"w": np.arange(16.0) * k}}
+
+
+@pytest.fixture
+def mgr(tmp_path):
+    m = ReliableCheckpointManager(
+        tmp_path / "ck", max_to_keep=10, backoff_base=0.0, sleep=lambda s: None
+    )
+    yield m
+    m.close()
+
+
+class TestRetryTransient:
+    def test_succeeds_after_transient_failures(self):
+        delays = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        with pytest.warns(RuntimeWarning, match="retrying"):
+            out = retry_transient(flaky, retries=3, backoff_base=0.5, sleep=delays.append)
+        assert out == "ok" and calls["n"] == 3
+        # Exponential: 0.5, then 1.0.
+        assert delays == [0.5, 1.0]
+
+    def test_backoff_is_capped(self):
+        delays = []
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 6:
+                raise OSError("transient")
+            return "ok"
+
+        with pytest.warns(RuntimeWarning):
+            retry_transient(flaky, retries=6, backoff_base=1.0, backoff_max=2.0, sleep=delays.append)
+        assert delays == [1.0, 2.0, 2.0, 2.0, 2.0]
+
+    def test_exhausted_retries_raise(self):
+        with pytest.warns(RuntimeWarning):
+            with pytest.raises(OSError, match="persistent"):
+                retry_transient(
+                    lambda: (_ for _ in ()).throw(OSError("persistent")),
+                    retries=2,
+                    sleep=lambda s: None,
+                )
+
+    def test_non_oserror_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def bad():
+            calls["n"] += 1
+            raise ValueError("logic bug, not weather")
+
+        with pytest.raises(ValueError):
+            retry_transient(bad, retries=5, sleep=lambda s: None)
+        assert calls["n"] == 1
+
+
+class TestManifest:
+    def test_save_writes_manifest_and_verify_passes(self, mgr):
+        assert mgr.save(1, state_at(1), metadata={"epoch": 0})
+        fp = mgr.ckpt_dir / "manifest_1.json"
+        assert fp.exists()
+        manifest = json.loads(fp.read_text())
+        assert manifest["step"] == 1 and manifest["files"]
+        assert all("sha256" in meta for meta in manifest["files"].values())
+        assert mgr.verify(1)
+
+    def test_silent_corruption_fails_verify(self, mgr):
+        mgr.save(1, state_at(1))
+        corrupt_checkpoint_step(mgr.ckpt_dir, 1, mode="garbage")
+        with pytest.warns(RuntimeWarning, match="checksum mismatch"):
+            assert not mgr.verify(1)
+
+    def test_missing_manifest_accepted_with_warning(self, mgr):
+        mgr.save(1, state_at(1))
+        (mgr.ckpt_dir / "manifest_1.json").unlink()
+        with pytest.warns(RuntimeWarning, match="no integrity manifest"):
+            assert mgr.verify(1)
+
+    def test_unreadable_manifest_fails_verify(self, mgr):
+        mgr.save(1, state_at(1))
+        (mgr.ckpt_dir / "manifest_1.json").write_text("{not json")
+        with pytest.warns(RuntimeWarning, match="unreadable manifest"):
+            assert not mgr.verify(1)
+
+    def test_pruned_steps_drop_their_sidecars(self, tmp_path):
+        m = ReliableCheckpointManager(tmp_path / "ck", max_to_keep=2, sleep=lambda s: None)
+        for k in (1, 2, 3):
+            m.save(k, state_at(k), metadata={"epoch": 0})
+        m.wait_until_finished()
+        assert m.all_steps() == [2, 3]
+        names = {p.name for p in m.ckpt_dir.glob("*.json")}
+        assert "manifest_1.json" not in names and "metadata_1.json" not in names
+        assert {"manifest_2.json", "manifest_3.json"} <= names
+        m.close()
+
+
+class TestWalkBackRestore:
+    def test_restores_latest_when_clean(self, mgr):
+        for k in (1, 2, 3):
+            mgr.save(k, state_at(k))
+        state, step = mgr.restore_latest_verified(state_at(0))
+        assert step == 3
+        np.testing.assert_array_equal(state["params"]["w"], np.arange(16.0) * 3)
+
+    def test_corrupt_latest_walks_back(self, mgr):
+        for k in (1, 2, 3):
+            mgr.save(k, state_at(k))
+        corrupt_checkpoint_step(mgr.ckpt_dir, 3, mode="garbage")
+        with pytest.warns(RuntimeWarning, match="walking back"):
+            state, step = mgr.restore_latest_verified(state_at(0))
+        assert step == 2
+        np.testing.assert_array_equal(state["params"]["w"], np.arange(16.0) * 2)
+
+    def test_legacy_truncated_step_walks_back_via_restore_failure(self, mgr):
+        """A step with no manifest (pre-integrity or killed mid-save) that is
+        also truncated: verification accepts it, the restore raises, and the
+        walk-back continues instead of crashing the resume."""
+        for k in (1, 2):
+            mgr.save(k, state_at(k))
+        (mgr.ckpt_dir / "manifest_2.json").unlink()
+        corrupt_checkpoint_step(mgr.ckpt_dir, 2, mode="truncate")
+        with pytest.warns(RuntimeWarning):
+            state, step = mgr.restore_latest_verified(state_at(0))
+        assert step == 1
+        np.testing.assert_array_equal(state["params"]["w"], np.arange(16.0))
+
+    def test_walk_back_deletes_unrestorable_newer_steps(self, mgr):
+        """Orbax ignores save(step <= latest_step), so the torn steps walked
+        past MUST be deleted — otherwise every re-save of the retrained
+        window is a silent no-op and the same progress is lost again on the
+        next crash."""
+        for k in (1, 2, 3):
+            mgr.save(k, state_at(k))
+        corrupt_checkpoint_step(mgr.ckpt_dir, 3, mode="garbage")
+        with pytest.warns(RuntimeWarning, match="walking back"):
+            _, step = mgr.restore_latest_verified(state_at(0))
+        assert step == 2
+        # The torn step and its sidecars are gone...
+        assert mgr.all_steps() == [1, 2]
+        assert not (mgr.ckpt_dir / "manifest_3.json").exists()
+        # ...so the retrained window can genuinely re-commit step 3.
+        assert mgr.save(3, state_at(3))
+        assert mgr.verify(3)
+        state, step = mgr.restore_latest_verified(state_at(0))
+        assert step == 3
+        np.testing.assert_array_equal(state["params"]["w"], np.arange(16.0) * 3)
+
+    def test_no_checkpoints_raises(self, mgr):
+        with pytest.raises(FileNotFoundError):
+            mgr.restore_latest_verified(state_at(0))
+
+    def test_everything_corrupt_raises(self, mgr):
+        mgr.save(1, state_at(1))
+        corrupt_checkpoint_step(mgr.ckpt_dir, 1, mode="garbage")
+        with pytest.warns(RuntimeWarning):
+            with pytest.raises(FileNotFoundError, match="No verifiable checkpoint"):
+                mgr.restore_latest_verified(state_at(0))
+
+
+class TestInjectedSaveErrors:
+    def test_transient_save_error_retried_with_backoff(self, tmp_path):
+        delays = []
+        m = ReliableCheckpointManager(
+            tmp_path / "ck", retries=3, backoff_base=0.25, sleep=delays.append
+        )
+        plan = FaultPlan([Fault(kind="save_error", save_index=0, times=2)])
+        with fault_plan(plan):
+            with pytest.warns(RuntimeWarning, match="retrying"):
+                assert m.save(1, state_at(1))
+        assert delays == [0.25, 0.5]
+        assert [f["attempt"] for f in plan.fired] == [0, 1]
+        assert m.verify(1)
+        m.close()
+
+    def test_persistent_save_error_propagates(self, tmp_path):
+        m = ReliableCheckpointManager(
+            tmp_path / "ck", retries=1, backoff_base=0.0, sleep=lambda s: None
+        )
+        with fault_plan(FaultPlan([Fault(kind="save_error", save_index=0, times=99)])):
+            with pytest.warns(RuntimeWarning):
+                with pytest.raises(OSError):
+                    m.save(1, state_at(1))
+        m.close()
+
+
+class TestMetadataSidecars:
+    def test_atomic_write_leaves_no_tmp(self, mgr):
+        mgr.save(1, state_at(1), metadata={"epoch": 0, "epoch_complete": False})
+        assert not list(mgr.ckpt_dir.glob("*.json.tmp"))
+        assert mgr.metadata(1) == {"epoch": 0, "epoch_complete": False}
+
+    def test_truncated_metadata_returns_none_with_warning(self, mgr):
+        mgr.save(1, state_at(1), metadata={"epoch": 0})
+        # Simulate the pre-atomic-write failure mode: a kill mid-write left
+        # undecodable JSON.
+        (mgr.ckpt_dir / "metadata_1.json").write_text('{"epoch": 0, "epo')
+        with pytest.warns(RuntimeWarning, match="undecodable checkpoint metadata"):
+            assert mgr.metadata(1) is None
+
+    def test_missing_metadata_returns_none(self, mgr):
+        mgr.save(1, state_at(1))
+        assert mgr.metadata(1) is None

@@ -1,0 +1,84 @@
+"""Graceful-shutdown unit tests: signal → flag, handler hygiene, contract.
+
+Fast (tier-1) coverage of ``reliability/preemption.py``: a real ``SIGTERM``
+delivered to this process sets the drain flag without killing it, previous
+handlers are restored on exit (also on error), the programmatic `request`
+path works outside the main thread, and the orchestrator-facing constants
+are pinned (they are a documented external contract — see
+docs/reliability.md — so a change must be deliberate).
+"""
+
+import os
+import signal
+import threading
+
+import pytest
+
+from eventstreamgpt_tpu.reliability import EXIT_PREEMPTED, GracefulShutdown, Preempted
+
+pytestmark = pytest.mark.reliability
+
+
+class TestContract:
+    def test_exit_code_pinned(self):
+        # Documented in docs/reliability.md; orchestrators key on it.
+        assert EXIT_PREEMPTED == 85
+
+    def test_preempted_carries_step(self):
+        e = Preempted("drained", step=42)
+        assert e.step == 42
+        assert isinstance(e, RuntimeError)
+
+
+class TestGracefulShutdown:
+    def test_real_sigterm_sets_flag_without_dying(self):
+        with GracefulShutdown() as shutdown:
+            assert not shutdown.requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            # Synchronous delivery in CPython: the handler runs before kill
+            # returns control to pure-Python code.
+            assert shutdown.requested
+
+    def test_sigint_also_drains(self):
+        with GracefulShutdown() as shutdown:
+            os.kill(os.getpid(), signal.SIGINT)
+            assert shutdown.requested
+
+    def test_previous_handlers_restored(self):
+        before_term = signal.getsignal(signal.SIGTERM)
+        before_int = signal.getsignal(signal.SIGINT)
+        with GracefulShutdown():
+            assert signal.getsignal(signal.SIGTERM) is not before_term
+        assert signal.getsignal(signal.SIGTERM) is before_term
+        assert signal.getsignal(signal.SIGINT) is before_int
+
+    def test_handlers_restored_on_error(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with pytest.raises(RuntimeError):
+            with GracefulShutdown():
+                raise RuntimeError("mid-fit failure")
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_programmatic_request(self):
+        shutdown = GracefulShutdown()  # no context: nothing installed
+        assert not shutdown.requested
+        shutdown.request()
+        assert shutdown.requested
+
+    def test_inert_outside_main_thread(self):
+        """Worker threads (ASHA sweep) must be able to enter the context:
+        no handler install (the signal module forbids it), request() still
+        works."""
+        before = signal.getsignal(signal.SIGTERM)
+        result = {}
+
+        def run():
+            with GracefulShutdown() as shutdown:
+                result["installed"] = signal.getsignal(signal.SIGTERM) is not before
+                shutdown.request()
+                result["requested"] = shutdown.requested
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join(timeout=10)
+        assert result == {"installed": False, "requested": True}

@@ -1,0 +1,158 @@
+"""Divergence-sentinel unit tests: verdicts, EMA, rollback bounds.
+
+Fast (tier-1) coverage of ``reliability/sentinel.py``: window classification
+(non-finite, gradient-norm ceiling, EMA spike), the consecutive-bad counter
+against K, EMA hygiene (bad windows must not drag the baseline), and the
+`RollbackController` bound-at-M / diagnostic-dump contract.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.reliability import (
+    DivergenceError,
+    DivergenceSentinel,
+    RollbackController,
+    SentinelConfig,
+)
+
+pytestmark = pytest.mark.reliability
+
+
+def window(losses, gnorms=None):
+    losses = np.asarray(losses, np.float32)
+    if gnorms is None:
+        gnorms = np.ones_like(losses)
+    return np.stack([losses, np.asarray(gnorms, np.float32)], axis=1)
+
+
+class TestConfigParsing:
+    def test_defaults_enabled(self):
+        cfg = SentinelConfig.from_trainer_config({})
+        assert cfg is not None
+        assert cfg.spike_factor is None and cfg.grad_norm_max is None
+        assert cfg.bad_windows_to_rollback == 1 and cfg.max_rollbacks == 3
+
+    def test_disabled(self):
+        assert SentinelConfig.from_trainer_config({"sentinel_enabled": False}) is None
+
+    def test_keys_parsed(self):
+        cfg = SentinelConfig.from_trainer_config(
+            {
+                "sentinel_ema_decay": 0.5,
+                "sentinel_spike_factor": 4.0,
+                "sentinel_grad_norm_max": 100.0,
+                "sentinel_warmup_windows": 2,
+                "sentinel_bad_windows": 3,
+                "sentinel_max_rollbacks": 7,
+            }
+        )
+        assert cfg.ema_decay == 0.5
+        assert cfg.spike_factor == 4.0
+        assert cfg.grad_norm_max == 100.0
+        assert cfg.warmup_windows == 2
+        assert cfg.bad_windows_to_rollback == 3
+        assert cfg.max_rollbacks == 7
+
+
+class TestVerdicts:
+    def test_healthy_window_updates_ema(self):
+        s = DivergenceSentinel(SentinelConfig(ema_decay=0.5))
+        assert s.observe_window(window([4.0, 2.0]), step=2, epoch=0)
+        # EMA seeds at the first loss, then decays: 4.0 -> 0.5*4 + 0.5*2 = 3.
+        assert s.ema == pytest.approx(3.0)
+        assert not s.should_rollback
+
+    def test_nan_loss_is_bad(self):
+        s = DivergenceSentinel(SentinelConfig())
+        assert not s.observe_window(window([1.0, np.nan]), step=2, epoch=0)
+        assert s.consecutive_bad == 1 and s.should_rollback
+
+    def test_nonfinite_grad_norm_is_bad(self):
+        s = DivergenceSentinel(SentinelConfig())
+        assert not s.observe_window(window([1.0], gnorms=[np.inf]), step=1, epoch=0)
+
+    def test_grad_norm_ceiling(self):
+        s = DivergenceSentinel(SentinelConfig(grad_norm_max=10.0))
+        assert s.observe_window(window([1.0], gnorms=[9.0]), step=1, epoch=0)
+        assert not s.observe_window(window([1.0], gnorms=[11.0]), step=2, epoch=0)
+
+    def test_spike_detection_respects_warmup(self):
+        s = DivergenceSentinel(SentinelConfig(spike_factor=3.0, warmup_windows=2, ema_decay=0.9))
+        # Window 1 (warm-up): even a big loss passes — no baseline yet.
+        assert s.observe_window(window([1.0]), step=1, epoch=0)
+        # Window 2: still inside warm-up (1 healthy window seen < 2).
+        assert s.observe_window(window([1.1]), step=2, epoch=0)
+        # Window 3: spike checks engaged; 1.2 is fine, 50x EMA is not.
+        assert s.observe_window(window([1.2]), step=3, epoch=0)
+        assert not s.observe_window(window([50.0]), step=4, epoch=0)
+        assert "loss spike" in s.history[-1]["reasons"][0]
+
+    def test_bad_window_does_not_update_ema(self):
+        s = DivergenceSentinel(SentinelConfig(spike_factor=2.0, warmup_windows=1))
+        s.observe_window(window([1.0]), step=1, epoch=0)
+        ema_before = s.ema
+        s.observe_window(window([100.0]), step=2, epoch=0)  # spike: bad
+        assert s.ema == ema_before
+
+    def test_consecutive_bad_resets_on_healthy(self):
+        s = DivergenceSentinel(SentinelConfig(bad_windows_to_rollback=2, grad_norm_max=1.0))
+        assert not s.observe_window(window([1.0], gnorms=[5.0]), step=1, epoch=0)
+        assert not s.should_rollback  # 1 < K=2
+        s.observe_window(window([1.0], gnorms=[0.5]), step=2, epoch=0)
+        assert s.consecutive_bad == 0
+        assert not s.observe_window(window([1.0], gnorms=[5.0]), step=3, epoch=0)
+        assert not s.observe_window(window([1.0], gnorms=[5.0]), step=4, epoch=0)
+        assert s.should_rollback
+
+    def test_reset_after_rollback(self):
+        s = DivergenceSentinel(SentinelConfig())
+        s.observe_window(window([1.0]), step=1, epoch=0)
+        s.observe_window(window([np.nan]), step=2, epoch=0)
+        s.reset_after_rollback()
+        assert s.consecutive_bad == 0 and s.ema is None and s.healthy_windows == 0
+
+    def test_history_records_summaries(self):
+        s = DivergenceSentinel(SentinelConfig())
+        s.observe_window(window([1.0, np.nan], gnorms=[2.0, np.nan]), step=2, epoch=1)
+        rec = s.history[-1]
+        assert rec["bad"] and rec["n_steps"] == 2 and rec["n_nonfinite"] == 1
+        assert rec["loss_mean"] == pytest.approx(1.0)  # finite entries only
+        assert rec["epoch"] == 1
+
+
+class TestRollbackController:
+    def test_epoch_skip_excises_poisoned_window(self, tmp_path):
+        ctl = RollbackController(3, tmp_path / "diag.json")
+        s = DivergenceSentinel(SentinelConfig())
+        ctl.request_rollback(s, epoch=0, step_in_epoch=6, global_step=10)
+        assert ctl.epoch_skip(0, 2) == 6  # restored skip 2 -> jump past batch 6
+        assert ctl.epoch_skip(0, 9) == 9  # never shrinks a larger skip
+        assert ctl.epoch_skip(1, 0) == 0  # other epochs untouched
+
+    def test_bounded_at_max_rollbacks(self, tmp_path):
+        diag = tmp_path / "diag.json"
+        ctl = RollbackController(1, diag)
+        s = DivergenceSentinel(SentinelConfig())
+        s.observe_window(window([np.nan]), step=1, epoch=0)
+        ctl.request_rollback(s, epoch=0, step_in_epoch=2, global_step=2)
+        with pytest.raises(DivergenceError) as exc_info:
+            ctl.request_rollback(s, epoch=0, step_in_epoch=4, global_step=4)
+        assert exc_info.value.diagnostics_fp == diag
+        dump = json.loads(diag.read_text())
+        assert dump["rollbacks"] == 2 and len(dump["rollback_events"]) == 2
+        assert dump["window_history"]  # sentinel history rides along
+
+    def test_abort_writes_diagnostics(self, tmp_path):
+        diag = tmp_path / "diag.json"
+        ctl = RollbackController(3, diag)
+        s = DivergenceSentinel(SentinelConfig(grad_norm_max=1.0))
+        s.observe_window(window([1.0], gnorms=[50.0]), step=1, epoch=0)
+        with pytest.raises(DivergenceError, match="no checkpoint"):
+            ctl.abort(s, reason="diverged with no checkpoint", epoch=0, global_step=1)
+        dump = json.loads(diag.read_text())
+        assert dump["reason"] == "diverged with no checkpoint"
+        assert dump["sentinel_config"]["grad_norm_max"] == 1.0
+        assert dump["window_history"][-1]["bad"]

@@ -1,0 +1,461 @@
+"""End-to-end recovery: every injected fault class survives a real train().
+
+Drives the full pretrain/fine-tune harnesses on a synthetic dataset with
+scripted `FaultPlan`s and asserts automatic recovery per fault class:
+
+* NaN batch → divergence rollback to the last good checkpoint, poisoned
+  window excised, run completes with finite losses;
+* loss spike (finite) → the EMA-spike path of the same rollback machine;
+* transient save ``OSError`` → retried with backoff, run unaffected;
+* corrupt latest checkpoint → walk-back restore, and the resumed loss
+  stream is **bit-identical** to an uninterrupted run (the rng-exact resume
+  contract);
+* SIGTERM mid-chunk → graceful drain, final checkpoint, `Preempted`, and a
+  bit-identical resume losing at most one chunk;
+* unbounded divergence → `DivergenceError` with the diagnostic dump (both
+  the rollback-budget and no-checkpoint-yet abort paths);
+* fine-tuning auto-resume parity (epoch-boundary and mid-epoch).
+
+Where the contract requires bit-exactness the assertions are exact float
+equality against a clean reference run, per (epoch, step) record.
+"""
+
+import json
+from collections import defaultdict
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.data import PytorchDatasetConfig
+from eventstreamgpt_tpu.data.synthetic import write_synthetic_dataset
+from eventstreamgpt_tpu.models.config import MetricsConfig, OptimizationConfig
+from eventstreamgpt_tpu.reliability import (
+    DivergenceError,
+    Fault,
+    FaultPlan,
+    Preempted,
+    ReliableCheckpointManager,
+    corrupt_checkpoint_step,
+    fault_plan,
+)
+from eventstreamgpt_tpu.training import PretrainConfig, train
+
+pytestmark = [pytest.mark.slow, pytest.mark.reliability]
+
+MODEL_KWARGS = dict(
+    hidden_size=32,
+    head_dim=8,
+    num_attention_heads=4,
+    num_hidden_layers=2,
+    intermediate_size=32,
+    TTE_generation_layer_type="log_normal_mixture",
+    TTE_lognormal_generation_num_components=2,
+)
+
+# 24 train subjects / batch 4 -> 6 deterministic batches per epoch.
+BSZ = 6  # batches per epoch
+STEPS = 12  # 2 epochs
+
+
+@pytest.fixture(scope="module")
+def synth_dir(tmp_path_factory):
+    dst = tmp_path_factory.mktemp("reliability_ds")
+    write_synthetic_dataset(
+        dst,
+        n_subjects_per_split={"train": 24, "tuning": 8},
+        n_event_types=8,
+        n_labs=32,
+        n_meds=8,
+        mean_seq_len=8,
+        max_seq_len=16,
+        seed=0,
+    )
+    return dst
+
+
+def make_cfg(synth_dir, save_dir, max_epochs=2, **tc_overrides):
+    tc = {
+        "log_every_n_steps": 1,
+        "checkpoint_every_n_steps": 2,
+        "max_checkpoints_to_keep": 10,
+    }
+    tc.update(tc_overrides)
+    cfg = PretrainConfig(
+        seed=1,
+        config=dict(MODEL_KWARGS),
+        optimization_config=OptimizationConfig(
+            init_lr=1e-3,
+            max_epochs=max_epochs,
+            batch_size=4,
+            validation_batch_size=4,
+            lr_frac_warmup_steps=0.5,
+            patience=None,
+        ),
+        data_config=PytorchDatasetConfig(save_dir=synth_dir, max_seq_len=8, min_seq_len=2),
+        pretraining_metrics_config=MetricsConfig(do_skip_all_metrics=True),
+        final_validation_metrics_config=MetricsConfig(do_skip_all_metrics=True),
+        experiment_dir=str(save_dir),
+        save_dir=str(save_dir),
+        trainer_config=tc,
+    )
+    cfg.do_final_validation_on_metrics = False
+    return cfg
+
+
+def read_log(save_dir) -> list[dict]:
+    return [json.loads(line) for line in (Path(save_dir) / "train_log.jsonl").open()]
+
+
+def train_records(save_dir) -> dict[tuple[int, int], list[float]]:
+    """All logged train losses grouped by (epoch, step) — a step retrained
+    after resume/rollback contributes multiple entries."""
+    by_step = defaultdict(list)
+    for r in read_log(save_dir):
+        if r["split"] == "train":
+            by_step[(r["epoch"], r["step"])].append(r["train_loss"])
+    return dict(by_step)
+
+
+def rollback_events(save_dir) -> list[dict]:
+    return [r for r in read_log(save_dir) if r.get("split") == "reliability"]
+
+
+@pytest.fixture(scope="module")
+def reference(synth_dir, tmp_path_factory):
+    """A clean 2-epoch run: the bit-exactness oracle for every resume test.
+
+    Single-entry map (epoch, step) -> loss on the default (device-resident
+    auto) feed path.
+    """
+    save = tmp_path_factory.mktemp("reference_run")
+    train(make_cfg(synth_dir, save))
+    recs = train_records(save)
+    assert len(recs) == STEPS and all(len(v) == 1 for v in recs.values())
+    return {k: v[0] for k, v in recs.items()}
+
+
+class TestDivergenceRollback:
+    def test_nan_batch_recovers(self, synth_dir, tmp_path):
+        """A NaN batch poisons the run mid-epoch; the sentinel detects it at
+        the checkpoint cadence, restores the last good checkpoint, excises
+        the poisoned window, and the run completes with finite losses."""
+        cfg = make_cfg(synth_dir, tmp_path, max_epochs=1, device_resident_data=False)
+        plan = FaultPlan([Fault(kind="nan_batch", epoch=0, batch_index=2)])
+        with fault_plan(plan):
+            train(cfg)
+        assert plan.fired == [{"kind": "nan_batch", "epoch": 0, "batch_index": 2}]
+
+        events = rollback_events(tmp_path)
+        assert len(events) == 1 and events[0]["event"] == "rollback"
+        assert events[0]["restored_step"] == 2  # last checkpoint before the NaN
+        # Post-rollback records are all finite, and the run reached the
+        # tuning eval with a finite loss.
+        recs = read_log(tmp_path)
+        post = recs[recs.index(events[0]) + 1 :]
+        train_post = [r for r in post if r["split"] == "train"]
+        assert train_post and all(np.isfinite(r["train_loss"]) for r in train_post)
+        tuning = [r for r in recs if r["split"] == "tuning"]
+        assert tuning and np.isfinite(tuning[-1]["tuning_loss"])
+        # The poisoned window was excised: the poisoned batch trained once
+        # (NaN), never again after the rollback.
+        diag = tmp_path / "divergence_diagnostics.json"
+        assert not diag.exists()  # recovered, not aborted
+
+    def test_loss_spike_recovers_via_ema(self, synth_dir, tmp_path):
+        """A finite loss spike (scaled batch values) trips the EMA-spike
+        detector — the divergence class non-finite checks cannot see."""
+        cfg = make_cfg(
+            synth_dir,
+            tmp_path,
+            max_epochs=1,
+            device_resident_data=False,
+            sentinel_spike_factor=3.0,
+            sentinel_warmup_windows=1,
+        )
+        plan = FaultPlan([Fault(kind="spike_batch", epoch=0, batch_index=2, scale=30.0)])
+        with fault_plan(plan):
+            train(cfg)
+        events = rollback_events(tmp_path)
+        assert len(events) == 1 and events[0]["restored_step"] == 2
+        # The spiked loss was finite (spike path, not the NaN path) ...
+        spiked = [
+            r
+            for r in read_log(tmp_path)
+            if r["split"] == "train" and r["train_loss"] > 100
+        ]
+        assert spiked and all(np.isfinite(r["train_loss"]) for r in spiked)
+        # ... and the run recovered to a normal finite tuning loss.
+        tuning = [r for r in read_log(tmp_path) if r["split"] == "tuning"]
+        assert tuning and tuning[-1]["tuning_loss"] < 100
+
+    def test_below_streak_bad_window_never_checkpoints(self, synth_dir, tmp_path):
+        """With K=2, the first bad window does not yet trigger rollback — but
+        it must not commit a checkpoint either, or the eventual rollback
+        would restore poisoned params and the run could never recover."""
+        cfg = make_cfg(
+            synth_dir,
+            tmp_path,
+            max_epochs=1,
+            device_resident_data=False,
+            sentinel_bad_windows=2,
+        )
+        plan = FaultPlan([Fault(kind="nan_batch", epoch=0, batch_index=2)])
+        with fault_plan(plan):
+            train(cfg)
+        events = rollback_events(tmp_path)
+        # Rollback fired on the SECOND bad window and restored the pre-NaN
+        # step-2 checkpoint — not the NaN state from the first bad window.
+        assert len(events) == 1 and events[0]["restored_step"] == 2
+        assert not (tmp_path / "divergence_diagnostics.json").exists()
+        tuning = [r for r in read_log(tmp_path) if r["split"] == "tuning"]
+        assert tuning and np.isfinite(tuning[-1]["tuning_loss"])
+
+    def test_rollback_clears_latched_stop(self, synth_dir, tmp_path):
+        """A max_training_steps stop latched inside the poisoned window must
+        be re-derived after the rollback rewinds global_step — otherwise the
+        run silently ends early with the budget unspent."""
+        cfg = make_cfg(synth_dir, tmp_path, max_epochs=1, device_resident_data=False)
+        cfg.optimization_config.max_training_steps = 4
+        plan = FaultPlan([Fault(kind="nan_batch", epoch=0, batch_index=2)])
+        with fault_plan(plan):
+            train(cfg)
+        recs = train_records(tmp_path)
+        # The full 4-step budget was spent, and the final budgeted step was
+        # retrained healthy after the rollback (not left at its NaN attempt).
+        assert max(s for _, s in recs) == 4
+        assert np.isfinite(recs[(0, 4)][-1])
+
+    def test_rollback_budget_exhaustion_aborts_with_diagnostics(self, synth_dir, tmp_path):
+        """Poison enough of the epoch that rollback cannot outrun it: past
+        max_rollbacks the run aborts with DivergenceError + the dump."""
+        cfg = make_cfg(
+            synth_dir,
+            tmp_path,
+            max_epochs=1,
+            device_resident_data=False,
+            sentinel_max_rollbacks=1,
+        )
+        plan = FaultPlan(
+            [Fault(kind="nan_batch", batch_index=i) for i in (2, 3, 4, 5)]
+        )
+        with fault_plan(plan):
+            with pytest.raises(DivergenceError):
+                train(cfg)
+        diag = tmp_path / "divergence_diagnostics.json"
+        assert diag.exists()
+        dump = json.loads(diag.read_text())
+        assert dump["rollbacks"] == 2 and dump["max_rollbacks"] == 1
+        assert dump["rollback_events"] and dump["window_history"]
+
+    def test_divergence_before_first_checkpoint_aborts(self, synth_dir, tmp_path):
+        """Divergence with nothing to roll back to (first window already bad,
+        so no checkpoint was ever committed) aborts with the dump instead of
+        looping."""
+        cfg = make_cfg(
+            synth_dir,
+            tmp_path,
+            max_epochs=1,
+            sentinel_grad_norm_max=1e-12,  # every window "diverges"
+        )
+        with pytest.raises(DivergenceError, match="before any restorable checkpoint"):
+            train(cfg)
+        dump = json.loads((tmp_path / "divergence_diagnostics.json").read_text())
+        assert dump["window_history"][0]["bad"]
+        # No checkpoint was committed from a bad window.
+        assert not any((tmp_path / "model_checkpoints").glob("manifest_*.json"))
+
+
+class TestCheckpointFaults:
+    def test_transient_save_error_is_retried(self, synth_dir, tmp_path, recwarn):
+        """Two injected OSErrors on the second save call: backoff retries
+        absorb them and the run is unaffected."""
+        cfg = make_cfg(synth_dir, tmp_path, ckpt_backoff_base=0.01)
+        plan = FaultPlan([Fault(kind="save_error", save_index=1, times=2)])
+        with fault_plan(plan):
+            train(cfg)
+        assert [f["attempt"] for f in plan.fired] == [0, 1]
+        assert sum("retrying" in str(w.message) for w in recwarn.list) >= 2
+        recs = train_records(tmp_path)
+        assert len(recs) == STEPS and all(np.isfinite(v[0]) for v in recs.values())
+
+    def test_corrupt_latest_checkpoint_walks_back_bit_exact(
+        self, synth_dir, tmp_path, reference
+    ):
+        """Corrupt the newest checkpoint of an interrupted run; the relaunch
+        walks back to the previous verifiable step, and every retrained +
+        continued step is bit-identical to the uninterrupted reference.
+
+        The interruption is a graceful drain at step 5 (NOT a shorter epoch
+        budget — that would change the LR schedule and the comparison would
+        be vacuous)."""
+        with fault_plan(FaultPlan([Fault(kind="sigterm", step=5)])):
+            with pytest.raises(Preempted):
+                train(make_cfg(synth_dir, tmp_path))
+        mgr = ReliableCheckpointManager(tmp_path / "model_checkpoints")
+        latest = mgr.latest_step()
+        assert latest == 5  # the drain checkpoint
+        corrupt_checkpoint_step(tmp_path / "model_checkpoints", latest, mode="garbage")
+        mgr.close()
+
+        with pytest.warns(RuntimeWarning, match="walking back"):
+            train(make_cfg(synth_dir, tmp_path))
+
+        recs = train_records(tmp_path)
+        # Full union coverage: every reference step trained at least once.
+        assert set(recs) == set(reference)
+        for key, losses in recs.items():
+            for loss in losses:
+                assert loss == reference[key], (key, losses, reference[key])
+        # The walk-back genuinely rewound past the corrupt step-5 checkpoint
+        # to step 4: step 5 trained twice (pre-drain + retrained), step 6
+        # only after the resume.
+        assert len(recs[(0, 5)]) == 2 and len(recs[(0, 6)]) == 1
+
+
+class TestGracefulPreemption:
+    def test_sigterm_drains_checkpoints_and_resumes_bit_exact(
+        self, synth_dir, tmp_path, reference
+    ):
+        """SIGTERM mid-epoch on the default (device-resident, scanned) path:
+        the loop drains at the chunk boundary, writes a final mid-epoch
+        checkpoint, raises Preempted; the relaunch resumes rng-exactly and
+        loses no logged progress."""
+        cfg = make_cfg(synth_dir, tmp_path)
+        plan = FaultPlan([Fault(kind="sigterm", step=3)])
+        with fault_plan(plan):
+            with pytest.raises(Preempted) as exc_info:
+                train(cfg)
+
+        drained_step = exc_info.value.step
+        assert drained_step is not None and drained_step >= 3
+        mgr = ReliableCheckpointManager(tmp_path / "model_checkpoints")
+        # The final checkpoint captured everything dispatched: at most one
+        # chunk beyond the scripted step, nothing lost behind it.
+        assert mgr.latest_step() == drained_step
+        meta = mgr.metadata(drained_step)
+        assert meta["epoch_complete"] is False
+        assert meta["step_in_epoch"] == drained_step  # epoch 0: steps == batches
+        assert mgr.verify(drained_step)
+        mgr.close()
+        logged = train_records(tmp_path)
+        assert max(s for _, s in logged) <= drained_step
+
+        # Relaunch: resumes past the drain point, completes, bit-exact.
+        train(make_cfg(synth_dir, tmp_path))
+        recs = train_records(tmp_path)
+        assert set(recs) == set(reference)
+        for key, losses in recs.items():
+            for loss in losses:
+                assert loss == reference[key], (key, losses, reference[key])
+        # No step behind the drain point was retrained: at most one chunk of
+        # duplicated work would show as doubled records here.
+        retrained = [k for k, v in recs.items() if len(v) > 1]
+        assert retrained == []
+
+
+class TestFinetuneResumeParity:
+    @pytest.fixture(scope="class")
+    def ft_dir(self, synth_dir, tmp_path_factory):
+        """A synthetic binary task df + a minimal pretrained save_dir."""
+        import jax
+        import pandas as pd
+
+        from eventstreamgpt_tpu.data import JaxDataset
+        from eventstreamgpt_tpu.models.config import StructuredTransformerConfig
+        from eventstreamgpt_tpu.training import build_model, save_pretrained
+
+        frames = [pd.read_parquet(f) for f in sorted((synth_dir / "DL_reps").glob("*.parquet"))]
+        raw = pd.concat(frames).drop_duplicates("subject_id")
+        rows = []
+        for _, row in raw.iterrows():
+            start = pd.Timestamp(row["start_time"])
+            times = np.asarray(row["time"], dtype=np.float64)
+            rows.append(
+                {
+                    "subject_id": row["subject_id"],
+                    "start_time": start,
+                    "end_time": start + pd.Timedelta(minutes=float(times[-1])),
+                    "label": bool(int(row["subject_id"]) % 2),
+                }
+            )
+        (synth_dir / "task_dfs").mkdir(exist_ok=True)
+        pd.DataFrame(rows).to_parquet(synth_dir / "task_dfs" / "mytask.parquet")
+
+        data_config = PytorchDatasetConfig(save_dir=synth_dir, max_seq_len=8, min_seq_len=2)
+        ds = JaxDataset(data_config, "train")
+        config = StructuredTransformerConfig(**MODEL_KWARGS)
+        config.set_to_dataset(ds)
+        model = build_model(config)
+        batch = next(ds.batches(4, shuffle=False))
+        params = model.init(jax.random.PRNGKey(0), batch)
+        model_dir = tmp_path_factory.mktemp("ft_pretrained")
+        save_pretrained(model_dir, params, config=config)
+        data_config.to_json_file(model_dir / "data_config.json", do_overwrite=True)
+        return model_dir
+
+    def make_ft_cfg(self, model_dir, save_dir, max_epochs):
+        from eventstreamgpt_tpu.training.fine_tuning import FinetuneConfig
+
+        cfg = FinetuneConfig(
+            load_from_model_dir=model_dir,
+            task_df_name="mytask",
+            seed=1,
+            optimization_config=OptimizationConfig(
+                init_lr=1e-3,
+                batch_size=4,
+                validation_batch_size=4,
+                max_epochs=max_epochs,
+                lr_frac_warmup_steps=0.5,
+                patience=None,
+            ),
+            data_config_overrides={},
+            trainer_config={
+                "log_every_n_steps": 1,
+                "checkpoint_every_n_steps": 2,
+                "max_checkpoints_to_keep": 10,
+            },
+        )
+        cfg.save_dir = Path(save_dir)
+        cfg.do_overwrite = True
+        cfg.do_final_validation_on_metrics = False
+        return cfg
+
+    def test_epoch_boundary_auto_resume(self, ft_dir, tmp_path):
+        """Fine-tuning now restores its own train-state checkpoints: a rerun
+        with a larger epoch budget continues instead of restarting."""
+        from eventstreamgpt_tpu.training.fine_tuning import train as finetune
+
+        save = tmp_path / "ft"
+        finetune(self.make_ft_cfg(ft_dir, save, max_epochs=1))
+        finetune(self.make_ft_cfg(ft_dir, save, max_epochs=2))
+        recs = read_log(save)
+        tr = [(r["epoch"], r["step"]) for r in recs if r["split"] == "train"]
+        assert tr == [(0, s) for s in range(1, BSZ + 1)] + [
+            (1, s) for s in range(BSZ + 1, 2 * BSZ + 1)
+        ]
+
+    def test_mid_epoch_preemption_resume(self, ft_dir, tmp_path):
+        """SIGTERM mid-epoch: Preempted with a final checkpoint; the relaunch
+        re-enters the epoch at the skip point and completes every step
+        exactly once."""
+        from eventstreamgpt_tpu.training.fine_tuning import train as finetune
+
+        save = tmp_path / "ft"
+        plan = FaultPlan([Fault(kind="sigterm", step=3)])
+        with fault_plan(plan):
+            with pytest.raises(Preempted) as exc_info:
+                finetune(self.make_ft_cfg(ft_dir, save, max_epochs=2))
+        assert exc_info.value.step == 3
+        mgr = ReliableCheckpointManager(save / "model_checkpoints")
+        meta = mgr.metadata(3)
+        assert meta == {"epoch": 0, "epoch_complete": False, "step_in_epoch": 3}
+        mgr.close()
+
+        finetune(self.make_ft_cfg(ft_dir, save, max_epochs=2))
+        recs = read_log(save)
+        tr = [(r["epoch"], r["step"]) for r in recs if r["split"] == "train"]
+        # Steps 1-3 pre-preemption, 4-12 post-resume; nothing retrained.
+        assert tr == [(0, s) for s in range(1, BSZ + 1)] + [
+            (1, s) for s in range(BSZ + 1, 2 * BSZ + 1)
+        ]
