@@ -4,8 +4,11 @@ PR 1 proved "no table-sized collectives" and "device-resident hot loop" via
 runtime tests; these properties are static facts of the lowered program, so
 this module gates them on every PR with no hardware and no epoch runs. It
 AOT-lowers the canonical step programs — the pretrain train step on the
-``dp8`` and ``dp4_tp2`` virtual-mesh layouts, the fine-tuning train step,
-and the single-dispatch generation program — and statically asserts:
+``dp8`` and ``dp4_tp2`` virtual-mesh layouts (compiled under the r06
+production-width remat policy, ``save_attention``), the NestedAttention
+flagship step (fused dep-graph attention + narrow head projections), the
+fine-tuning train step, and the single-dispatch generation program — and
+statically asserts:
 
 * **no f64** element types anywhere in the module (TPUs emulate f64; one
   stray weak-typed ``np.float64`` constant doubles a table),
@@ -80,13 +83,18 @@ def _require_devices(n: int) -> None:
 
 
 # ----------------------------------------------------------- canonical steps
-def canonical_pretrain_step(n_data: int, n_model: int, with_health: bool = False):
+def canonical_pretrain_step(n_data: int, n_model: int, with_health: bool = False, na: bool = False):
     """The production pretrain train step on a ``data×model`` mesh — the
     exact construction ``dryrun_multichip`` audits into ``COLLECTIVES.json``
     (same tiny shapes, so inventories are directly comparable).
+
     ``with_health`` builds the divergence-sentinel-instrumented variant,
     which is what ``train()`` jits by default since the reliability
-    subsystem landed (sentinel_enabled defaults to true)."""
+    subsystem landed (sentinel_enabled defaults to true). ``na`` builds the
+    NestedAttention flagship (fused dep-graph attention + narrow head
+    projections — the r06 NA production defaults). CI programs compile
+    under ``gradient_checkpointing="save_attention"`` (the r06
+    production-width remat policy), matching the dry run."""
     import jax
     import jax.numpy as jnp
 
@@ -97,7 +105,12 @@ def canonical_pretrain_step(n_data: int, n_model: int, with_health: bool = False
     ge = _graft_entry()
     _require_devices(n_data * n_model)
     mesh = make_mesh(n_data, n_model)
-    model, batch = ge._make_model_and_batch(batch_size=2 * n_data)
+    if na:
+        model, batch = ge._make_model_and_batch(batch_size=2 * n_data, na=True)
+    else:
+        model, batch = ge._make_model_and_batch(
+            batch_size=2 * n_data, gradient_checkpointing="save_attention"
+        )
     params = model.init(jax.random.PRNGKey(0), batch)
     oc = OptimizationConfig(
         init_lr=1e-3,
@@ -257,6 +270,12 @@ def run_program_checks(
     # the divergence sentinel's contract is that it adds no collectives and
     # no host traffic to the step.
     programs["pretrain:dp8_health"] = canonical_pretrain_step(8, 1, with_health=True)
+    # The NA flagship (r06): fused dep-graph attention + narrow head
+    # projections are production defaults, so the lowered NA program is held
+    # to the same f64-free/host-transfer-free gates and its own committed
+    # collective budget — the fused walk must not smuggle host callbacks or
+    # unbudgeted collectives into the step.
+    programs["pretrain:na_dp8"] = canonical_pretrain_step(8, 1, na=True)
     programs["finetune:dp8"] = canonical_finetune_step(8)
     programs["finetune:dp8_health"] = canonical_finetune_step(8, with_health=True)
     programs["generation:ci"] = canonical_generation_program()
@@ -271,9 +290,11 @@ def run_program_checks(
 
     if compile_collectives:
         # label -> COLLECTIVES.json budget key; the health variant reuses the
-        # bare dp8 budget (the sentinel must live within it).
+        # bare dp8 budget (the sentinel must live within it), the NA program
+        # has its own committed budget (na_dp8).
         budget_keys = {f"pretrain:{name}": name for name in layouts}
         budget_keys["pretrain:dp8_health"] = "dp8"
+        budget_keys["pretrain:na_dp8"] = "na_dp8"
         for label, budget_key in budget_keys.items():
             log(f"compiling {label} for the collective budget gate")
             compiled = lowered[label].compile()

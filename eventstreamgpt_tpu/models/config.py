@@ -206,6 +206,8 @@ class StructuredTransformerConfig(JSONableMixin):
         precision: str = "fp32",
         dep_graph_attention_types: ATTENTION_TYPES_LIST_T | None = None,
         dep_graph_window_size: int | None = 2,
+        dep_graph_fused_attention: bool | None = True,
+        head_narrow_projections: bool = True,
         intermediate_size: int = 32,
         activation_function: str = "gelu",
         attention_dropout: float = 0.1,
@@ -330,6 +332,7 @@ class StructuredTransformerConfig(JSONableMixin):
                     "do_full_block_in_seq_attention",
                     "do_full_block_in_dep_graph_attention",
                     "dep_graph_window_size",
+                    "dep_graph_fused_attention",
                 )
             }
             if measurements_per_dep_graph_level is not None:
@@ -365,6 +368,14 @@ class StructuredTransformerConfig(JSONableMixin):
                 if dep_graph_window_size != _na_only_defaults["dep_graph_window_size"]:
                     print(extra_param_err_tmpl.format("dep_graph_window_size", dep_graph_window_size))
                 dep_graph_window_size = None
+            if dep_graph_fused_attention is not None:
+                if dep_graph_fused_attention != _na_only_defaults["dep_graph_fused_attention"]:
+                    print(
+                        extra_param_err_tmpl.format(
+                            "dep_graph_fused_attention", dep_graph_fused_attention
+                        )
+                    )
+                dep_graph_fused_attention = None
         else:
             raise ValueError(
                 "`structured_event_processing_mode` must be a valid `StructuredEventProcessingMode` "
@@ -436,25 +447,43 @@ class StructuredTransformerConfig(JSONableMixin):
         # off-TPU evals of pallas_flash checkpoints are fp32-rounding-close to
         # TPU, not bit-exact; 'einsum' remains the bit-exact-everywhere path.
         self.attention_implementation = attention_implementation
-        # Rematerialization policy for the encoder blocks (VERDICT r05 #3).
-        # "none" saves all activations (fastest when they fit HBM — the
-        # production default; the width probe runs without remat), "block"
-        # re-runs each block's forward in its backward (nn.remat, minimum
-        # memory), "dots" / "dots_no_batch" are jax.checkpoint selective
-        # policies that save matmul outputs and recompute only elementwise
-        # work — the middle ground for long-context/deep configs whose
-        # activations overflow HBM. Measured A/B at the production-width
-        # probe shape: BASELINE.md "Rematerialization" table.
-        if gradient_checkpointing not in ("none", "block", "dots", "dots_no_batch"):
+        # Rematerialization policy for the encoder blocks (VERDICT r05 #3;
+        # r06 MFU round). "none" saves all activations (fastest when they fit HBM;
+        # at toy shapes every policy only adds recompute), "block" re-runs
+        # each block's forward in its backward (nn.remat, minimum memory),
+        # "dots" / "dots_no_batch" are jax.checkpoint selective policies
+        # that save matmul outputs and recompute only elementwise work,
+        # and "save_attention" composes dots_no_batch with
+        # save_only_these_names on the checkpoint-named attention outputs
+        # so the backward never re-executes the flash/splash/band attention
+        # custom-calls — the production-width policy candidate (the bench
+        # width probe A/Bs it against dots_no_batch every run and reports
+        # both; docs/performance.md). Measured A/Bs: BASELINE.md
+        # "Rematerialization" tables.
+        if gradient_checkpointing not in (
+            "none", "block", "dots", "dots_no_batch", "save_attention"
+        ):
             raise ValueError(
                 "gradient_checkpointing must be one of 'none', 'block', 'dots', "
-                f"'dots_no_batch'; got {gradient_checkpointing}"
+                f"'dots_no_batch', 'save_attention'; got {gradient_checkpointing}"
             )
         self.gradient_checkpointing = gradient_checkpointing
         if precision not in ("fp32", "bf16"):
             raise ValueError(f"precision must be 'fp32' or 'bf16'; got {precision}")
         self.precision = precision
         self.dep_graph_window_size = dep_graph_window_size
+        # NA-only: route the per-event dep-graph walk through the fused
+        # broadcast-reduce attention (ops/band_attention.dep_graph_attention)
+        # instead of batched tiny dot_generals. Numerics-parity gated in
+        # tests (tests/models/test_dep_graph_fused.py); False restores the
+        # einsum path for A/Bs (bench.py records both every run).
+        self.dep_graph_fused_attention = dep_graph_fused_attention
+        # Output-head classification projections: when a call needs only a
+        # narrow vocabulary span (the NA per-level walk), project just those
+        # columns of the ClassificationLayer kernel instead of the full
+        # (hidden, vocab) plane — column-exact, checkpoint-compatible
+        # (models/model_output.py `VocabProjection`).
+        self.head_narrow_projections = head_narrow_projections
 
         missing_param_err_tmpl = f"For a {TTE_generation_layer_type} model, {{}} should not be None"
         extra_param_err_tmpl = (

@@ -26,10 +26,12 @@ row start is given segment -2 so it can never match.
 
 from __future__ import annotations
 
+from typing import Callable
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["band_local_attention"]
+__all__ = ["band_local_attention", "dep_graph_attention"]
 
 
 def band_local_attention(
@@ -113,3 +115,72 @@ def band_local_attention(
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhncj,bhnjd->bhncd", probs.astype(v2.dtype), v2)
     return out.reshape(B, H, L, D)
+
+
+def dep_graph_attention(
+    query: jnp.ndarray,
+    key: jnp.ndarray,
+    value: jnp.ndarray,
+    q_offset: int = 0,
+    window: int | None = None,
+    probs_transform: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+) -> jnp.ndarray:
+    """Fused causal attention over tiny per-event dependency-graph rows.
+
+    The NA dep-graph walk attends over ``S = G+1`` positions per flattened
+    event row (history token + G graph levels; ``S`` is 4 at the bench
+    shape). At that size a batched ``dot_general`` formulation is all
+    overhead: XLA tiles each (Q, S) logits plane as an MXU matmul against
+    the ``(B·L, H, G, d)`` layout and pays relayout copies comparable to
+    the matmuls themselves (~1.5 ms/step at the bench shape) plus lost
+    loop fusion in the backward (~1.1 ms) — the ``scripts/probe_na.py``
+    attribution, VERDICT r05 "Next round" #6.
+
+    This formulation contains **no dot_general at all**: logits and the
+    probability-weighted value sum are broadcast-multiply + lane-reduction
+    contractions, which XLA fuses — together with the causal/window mask,
+    the fp32 softmax, and optional attention dropout — into one fusion
+    scope per direction on every backend. FLOP count is identical to the
+    einsum path (2·N·H·Q·S·D per contraction ≈ 50 MFLOPs at bench shape:
+    VPU-trivial); what it removes is the layout friction around
+    MXU-shaped ops that are far too small to tile.
+
+    Args:
+        query: ``(N, Q, H, D)`` — ``N`` flattened event rows, ``Q`` query
+            positions (``S - q_offset`` when the first graph position is
+            key/value-only history).
+        key / value: ``(N, S, H, D)``.
+        q_offset: absolute position of query 0 (1 under ``static_kv_first``).
+        window: optional sliding-window width over graph positions
+            (``dep_graph_attention_types="local"``); ``None`` = global.
+        probs_transform: optional hook applied to the ``(N, Q, S, H)``
+            fp32 attention probabilities (attention dropout).
+
+    Returns:
+        ``(N, Q, H, D)`` attention outputs in ``value``'s dtype. Logits are
+        NOT scaled by ``1/sqrt(D)`` (GPT-Neo lineage) and softmax runs in
+        fp32, exactly like the einsum path in ``models/transformer.py``.
+    """
+    N, Q, H, D = query.shape
+    S = key.shape[1]
+    q_pos = jnp.arange(Q) + q_offset
+    k_pos = jnp.arange(S)
+    mask = k_pos[None, :] <= q_pos[:, None]  # causal over graph positions
+    if window is not None:
+        mask = mask & (k_pos[None, :] > q_pos[:, None] - window)
+
+    # bf16 products are exact in fp32, so upcast-then-multiply reproduces the
+    # MXU's bf16-multiply/fp32-accumulate numerics of the einsum path.
+    qf = query.astype(jnp.float32)
+    kf = key.astype(jnp.float32)
+    logits = (qf[:, :, None] * kf[:, None, :]).sum(axis=-1)  # (N, Q, S, H)
+    logits = jnp.where(mask[None, :, :, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=2)
+    if probs_transform is not None:
+        probs = probs_transform(probs)
+    # Match the einsum path's probs dtype drop before the PV contraction,
+    # then accumulate in fp32.
+    pv = probs.astype(value.dtype).astype(jnp.float32)[..., None] * value.astype(
+        jnp.float32
+    )[:, None]
+    return pv.sum(axis=2).astype(value.dtype)  # (N, Q, H, D)

@@ -55,12 +55,15 @@ def get_generative_predictions(
     use_cache: bool = True,
     mesh=None,
     do_validate_batch: bool = True,
+    return_generated: bool = False,
 ):
     """Generates, labels, and averages into empirical label probabilities.
 
     Reference ``:213-276``. Returns ``(StreamClassificationModelOutput-like,
     frac_unpredictable per original subject)``; subjects with no predictable
-    samples are dropped from preds/labels.
+    samples are dropped from preds/labels. With ``return_generated`` the
+    generated batch is appended to the tuple (the zero-shot bench counts
+    generated events from it).
     """
     B = batch.batch_size
     generated = generate(
@@ -104,9 +107,12 @@ def get_generative_predictions(
         true_labels = true_labels.astype(np.int64)
 
     output = SimpleNamespace(loss=float("nan"), preds=probs, labels=true_labels)
-    return output, frac_unpredictable[
+    frac = frac_unpredictable[
         np.asarray(batch.valid_mask) if batch.valid_mask is not None else slice(None)
     ]
+    if return_generated:
+        return output, frac, generated
+    return output, frac
 
 
 def zero_shot_evaluation(

@@ -1,27 +1,41 @@
-"""Device op-level profile of the NestedAttention train step (VERDICT r05 #2).
+"""Device op-level profile of the NestedAttention train step (VERDICT r05 #2,
+r06 #6).
 
 Same protocol as ``profile_width.py`` (hlo_stats from a jax.profiler trace)
 at the bench NA shape (B=32, L=256, hidden 256, 2 layers, 3 dep-graph
 levels), plus the CI step at the identical shape for a side-by-side op
 attribution of the NA-vs-CI cost ratio.
 
-    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python scripts/probe_na.py
+By default the NA model runs the r06 production configuration — the fused
+dep-graph attention (``ops/band_attention.dep_graph_attention``) and narrow
+head projections — so the attribution describes the post-fusion program.
+Each invocation profiles ONE arm and prints its sustained step time, its
+NA/CI ratio, and the per-category hlo_stats table; run once per arm
+(``--unfused`` for the pre-r06 einsum walk, ``--full-heads`` for full-plane
+head projections) and difference the printed step times for per-lever
+deltas. The step-level A/B of record is automated in ``bench.py``
+(``na_fused_ab_probe_ms``).
+
+    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python scripts/probe_na.py \
+        [--unfused] [--full-heads]
 """
 
 from __future__ import annotations
 
+import argparse
 import sys
 import tempfile
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 
+from profile_width import summarize_categories as summarize  # noqa: E402
 from profile_width import top_ops_from_trace  # noqa: E402
 
 BATCH, SEQ_LEN, HIDDEN = 32, 256, 256
 
 
-def build(na: bool):
+def build(na: bool, fused: bool = True, narrow_heads: bool = True):
     import jax
     import jax.numpy as jnp
 
@@ -71,7 +85,9 @@ def build(na: bool):
             dep_graph_attention_types="global",
             do_full_block_in_seq_attention=False,
             do_full_block_in_dep_graph_attention=True,
+            dep_graph_fused_attention=fused,
         )
+    kwargs["head_narrow_projections"] = narrow_heads
     config = StructuredTransformerConfig(**kwargs)
     config.set_to_dataset(train_ds)
     model = build_model(config)
@@ -87,12 +103,12 @@ def build(na: bool):
     return make_train_step(model, tx), state, resident
 
 
-def profile(name: str, na: bool, steps: int = 8):
+def profile(name: str, na: bool, steps: int = 8, fused: bool = True, narrow_heads: bool = True):
     import jax
 
     from eventstreamgpt_tpu.utils.benchmarking import drain, sustained_step_ms
 
-    step, state, resident = build(na)
+    step, state, resident = build(na, fused=fused, narrow_heads=narrow_heads)
     rng = jax.random.PRNGKey(0)
     state, loss = step(state, resident, rng)
     drain(loss)
@@ -115,24 +131,27 @@ def profile(name: str, na: bool, steps: int = 8):
     return step_ms, rows
 
 
-def summarize(rows, top=25):
-    """hlo_stats table ({cols, rows} gviz-style) -> [(category, self_us)]."""
-    cols = [c["label"] if isinstance(c, dict) else c for c in rows["cols"]]
-    i_cat = cols.index("HLO op category")
-    i_self = cols.index("Total self time (us)")
-    agg = {}
-    for r in rows["rows"]:
-        c = r["c"] if isinstance(r, dict) else r
-        vals = [x.get("v") if isinstance(x, dict) else x for x in c]
-        agg[vals[i_cat]] = agg.get(vals[i_cat], 0.0) + float(vals[i_self] or 0)
-    return sorted(agg.items(), key=lambda kv: -kv[1])[:top]
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--unfused",
+        action="store_true",
+        help="profile the pre-r06 einsum dep-graph walk (the A/B arm)",
+    )
+    ap.add_argument(
+        "--full-heads",
+        action="store_true",
+        help="restore full-plane head projections (head_narrow_projections=False)",
+    )
+    args = ap.parse_args(argv)
 
-
-def main():
-    na_ms, na_rows = profile("na", na=True)
-    ci_ms, ci_rows = profile("ci", na=False)
-    print(f"\nNA {na_ms:.2f} ms vs CI {ci_ms:.2f} ms -> ratio {na_ms/ci_ms:.2f}")
-    print("\n-- NA by category (self us over traced steps) --")
+    fused = not args.unfused
+    narrow = not args.full_heads
+    variant = f"fused={fused} narrow_heads={narrow}"
+    na_ms, na_rows = profile("na", na=True, fused=fused, narrow_heads=narrow)
+    ci_ms, ci_rows = profile("ci", na=False, narrow_heads=narrow)
+    print(f"\nNA [{variant}] {na_ms:.2f} ms vs CI {ci_ms:.2f} ms -> ratio {na_ms/ci_ms:.2f}")
+    print(f"\n-- NA [{variant}] by category (self us over traced steps) --")
     for k, v in summarize(na_rows):
         print(f"  {v:10.0f}  {k}")
     print("\n-- CI by category --")

@@ -1,11 +1,16 @@
 """Rematerialization-policy A/B at the production-width probe shape.
 
-VERDICT r05 #3: measure whole-block remat and the `jax.checkpoint`
-selective policies against no-remat at hidden-1024/12L (both head_dims),
-sustained protocol. Also records per-policy compiled peak HBM (from
-``compiled.memory_analysis()``) so the speed/memory trade is explicit.
+VERDICT r05 #3 / r06 #2: measure whole-block remat and the
+`jax.checkpoint` selective policies — including ``save_attention``
+(dots_no_batch + checkpoint-named attention outputs, so the backward
+never re-executes the flash/splash/band custom-calls) — against no-remat
+at hidden-1024/12L (both head_dims), sustained protocol.
 
     python scripts/probe_remat.py [--head-dim 128]
+
+Microbenches pick candidates; ``bench.py``'s width section A/Bs
+``dots_no_batch`` vs ``save_attention`` at the step level every run and
+reports both (``width1024_remat_ab_ms``) — the artifact picks the default.
 """
 
 from __future__ import annotations
@@ -95,7 +100,11 @@ def build(head_dim: int, policy: str):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--head-dim", type=int, default=128)
-    ap.add_argument("--policies", nargs="*", default=["none", "dots_no_batch", "dots", "block"])
+    ap.add_argument(
+        "--policies",
+        nargs="*",
+        default=["none", "dots_no_batch", "save_attention", "dots", "block"],
+    )
     args = ap.parse_args(argv)
 
     import jax

@@ -6,7 +6,13 @@ the xplane with ``xprof``). This is the tool that produced the "remaining
 hot spots" table in BASELINE.md.
 
     PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
-        python scripts/profile_width.py [--hidden 1024 --layers 12 --head-dim 128]
+        python scripts/profile_width.py \
+        [--hidden 1024 --layers 12 --head-dim 128 --policy save_attention]
+
+``--policy`` selects the rematerialization policy the step compiles under
+(default: ``save_attention``, the r06 production-width candidate) — the
+backward's recompute mix is policy-dependent, so attributions must name
+the policy they were taken under (VERDICT r05 weak #6).
 
 (The pure-python protobuf flag is needed because the installed
 tensorflow/xprof protobuf generations disagree; parsing is slow but the
@@ -30,7 +36,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
 PACKED_BATCH, PACKED_SEQ_LEN = 8, 1024
 
 
-def build_step(hidden: int, layers: int, head_dim: int):
+def build_step(hidden: int, layers: int, head_dim: int, policy: str = "save_attention"):
     import jax
     import jax.numpy as jnp
 
@@ -78,6 +84,7 @@ def build_step(hidden: int, layers: int, head_dim: int):
         TTE_lognormal_generation_num_components=3,
         attention_implementation="pallas_flash",
         attention_dropout=0.0,
+        gradient_checkpointing=policy,
         precision="bf16",
     )
     config.set_to_dataset(train_ds)
@@ -116,11 +123,36 @@ def top_ops_from_trace(trace_dir: str, top_n: int = 30):
     raise RuntimeError("no usable xprof tool produced data")
 
 
+def summarize_categories(rows, top=25):
+    """hlo_stats table ({cols, rows} gviz-style) -> [(category, self_us)].
+
+    The per-category rollup that produced BASELINE.md's head-stack tables
+    (dense matmuls vs attention custom-calls vs scatter/gather vs loop
+    fusions); re-run this under each remat policy (``--policy``) to see what
+    the backward actually recomputes.
+    """
+    cols = [c["label"] if isinstance(c, dict) else c for c in rows["cols"]]
+    i_cat = cols.index("HLO op category")
+    i_self = cols.index("Total self time (us)")
+    agg: dict = {}
+    for r in rows["rows"]:
+        c = r["c"] if isinstance(r, dict) else r
+        vals = [x.get("v") if isinstance(x, dict) else x for x in c]
+        agg[vals[i_cat]] = agg.get(vals[i_cat], 0.0) + float(vals[i_self] or 0)
+    return sorted(agg.items(), key=lambda kv: -kv[1])[:top]
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--hidden", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument(
+        "--policy",
+        default="save_attention",
+        help="gradient_checkpointing policy to profile under "
+        "(none|block|dots|dots_no_batch|save_attention)",
+    )
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--trace-dir", default=None)
     args = ap.parse_args(argv)
@@ -129,7 +161,7 @@ def main(argv=None):
 
     from eventstreamgpt_tpu.utils.benchmarking import drain, wait_for_quiet
 
-    step, state, resident = build_step(args.hidden, args.layers, args.head_dim)
+    step, state, resident = build_step(args.hidden, args.layers, args.head_dim, args.policy)
     rng = jax.random.PRNGKey(0)
     state, loss = step(state, resident, rng)  # compile
     drain(loss)
@@ -145,7 +177,11 @@ def main(argv=None):
     print(f"trace written to {trace_dir}", file=sys.stderr)
 
     tool, rows = top_ops_from_trace(trace_dir)
-    print(f"parsed with tool={tool}")
+    print(f"parsed with tool={tool} (policy={args.policy})")
+    if tool in ("hlo_stats", "hlo_op_stats") and isinstance(rows, dict):
+        print("-- by HLO op category (device self us over traced steps) --")
+        for k, v in summarize_categories(rows):
+            print(f"  {v:10.0f}  {k}")
     print(json.dumps(rows)[:20000] if not isinstance(rows, list) else rows[:40])
 
 

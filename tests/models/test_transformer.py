@@ -206,7 +206,7 @@ class TestRematPolicies:
     def test_policies_match_no_remat(self):
         batch = make_batch()
         ref_grads = None
-        for policy in ("none", "block", "dots", "dots_no_batch"):
+        for policy in ("none", "block", "dots", "dots_no_batch", "save_attention"):
             config = small_config(gradient_checkpointing=policy)
             model = ConditionallyIndependentPointProcessTransformer(config)
             params = model.init(jax.random.PRNGKey(0), batch)

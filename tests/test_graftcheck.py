@@ -619,3 +619,19 @@ class TestLoweredProgramGates:
         text = fn.lower(*args).as_text()
         assert check_no_f64(text, "finetune:dp8") == []
         assert check_no_host_transfers(text, "finetune:dp8") == []
+
+    def test_na_fused_step_is_f64_and_host_transfer_free(self):
+        """The r06 NA flagship program (fused dep-graph attention + narrow
+        head projections): the fused walk is elementwise/reduce work and the
+        narrow projections are kernel column slices — neither may introduce
+        f64 constants or host callbacks into the lowered step."""
+        from eventstreamgpt_tpu.analysis.program_checks import (
+            canonical_pretrain_step,
+            check_no_f64,
+            check_no_host_transfers,
+        )
+
+        fn, args = canonical_pretrain_step(8, 1, na=True)
+        text = fn.lower(*args).as_text()
+        assert check_no_f64(text, "pretrain:na_dp8") == []
+        assert check_no_host_transfers(text, "pretrain:na_dp8") == []
