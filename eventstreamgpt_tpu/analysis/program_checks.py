@@ -39,6 +39,7 @@ __all__ = [
     "canonical_pretrain_step",
     "canonical_finetune_step",
     "canonical_generation_program",
+    "canonical_engine_programs",
     "check_no_f64",
     "check_no_host_transfers",
     "check_collective_budget",
@@ -188,6 +189,42 @@ def canonical_generation_program(max_new_events: int = 4):
     return steps["generate_program"], (params, batch, jax.random.PRNGKey(0))
 
 
+def canonical_engine_programs(n_data: int = 8) -> dict:
+    """The serving engine's prefill + decode-slot programs, slots sharded
+    data-parallel over the virtual mesh (``serving/engine.py``).
+
+    The decode-slot program is the serving hot loop: it must stay free of
+    host transfers (per-row stopping is judged ON DEVICE — a smuggled
+    callback would resurrect the per-event host sync the engine exists to
+    remove) and within the committed ``engine_dp8`` collective budget
+    (slot-sharded decode with replicated params is collective-free by
+    construction; the budget gate keeps it that way). Returns the engine's
+    ``aot_programs()`` dict: label -> (jitted fn, example args).
+    """
+    import jax
+
+    from ..serving import GenerationEngine
+    from ..training.sharding import make_mesh
+
+    ge = _graft_entry()
+    _require_devices(n_data)
+    mesh = make_mesh(n_data, 1)
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=2 * n_data,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        mesh=mesh,
+    )
+    return engine.aot_programs(bucket_len=8, group=2)
+
+
 # ------------------------------------------------------------------- checks
 def check_no_f64(program_text: str, label: str = "program") -> list[str]:
     """No f64 element types anywhere in the lowered/compiled module."""
@@ -279,6 +316,11 @@ def run_program_checks(
     programs["finetune:dp8"] = canonical_finetune_step(8)
     programs["finetune:dp8_health"] = canonical_finetune_step(8, with_health=True)
     programs["generation:ci"] = canonical_generation_program()
+    # The serving engine's programs (slot-sharded over dp8): the decode-slot
+    # program is the serving hot loop and additionally gates against its own
+    # committed collective budget below.
+    for label, (fn, args) in canonical_engine_programs(8).items():
+        programs[f"engine:{label}"] = (fn, args)
 
     lowered = {}
     for label, (fn, args) in programs.items():
@@ -295,6 +337,8 @@ def run_program_checks(
         budget_keys = {f"pretrain:{name}": name for name in layouts}
         budget_keys["pretrain:dp8_health"] = "dp8"
         budget_keys["pretrain:na_dp8"] = "na_dp8"
+        budget_keys["engine:decode"] = "engine_dp8"
+        budget_keys["engine:prefill_b8"] = "engine_prefill_dp8"
         for label, budget_key in budget_keys.items():
             log(f"compiling {label} for the collective budget gate")
             compiled = lowered[label].compile()

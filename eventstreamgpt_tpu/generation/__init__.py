@@ -7,8 +7,10 @@ from .sampling import (  # noqa: F401
     sample_predictions,
     update_last_event_data,
 )
-from .generation_utils import generate  # noqa: F401
+from .generation_utils import GenerationOutput, generate  # noqa: F401
 from .stopping_criteria import (  # noqa: F401
+    DeadRowCriteria,
+    DeviceCriterion,
     MaxLengthCriteria,
     StoppingCriteria,
     StoppingCriteriaList,

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from flax import struct
+
 from ..data.types import EventStreamBatch
 from ..models.config import StructuredEventProcessingMode, StructuredTransformerConfig
 from ..models.transformer import NAPast, init_kv_caches, time_from_deltas
@@ -41,6 +43,27 @@ from .sampling import append_new_event, sample_predictions, update_last_event_da
 from .stopping_criteria import MaxLengthCriteria, StoppingCriteriaList
 
 Array = Any
+
+
+@struct.dataclass
+class GenerationOutput:
+    """A completed generation plus per-row accounting.
+
+    ``generate(..., return_output=True)`` wraps its result batch with
+    per-row ``n_generated`` — the count of REAL events each row produced
+    (rows whose prompts end in padding generate only masked events and
+    count 0; a fired stopping criterion shortens every row). Previously
+    only whole-batch event totals were observable from the result batch.
+    """
+
+    batch: EventStreamBatch
+    n_generated: Array  # (B,) int32: real generated events per row
+    input_len: int = struct.field(pytree_node=False, default=0)
+
+
+def _with_accounting(batch: EventStreamBatch, input_len: int) -> GenerationOutput:
+    n_gen = batch.event_mask[:, input_len:].sum(axis=1).astype(jnp.int32)
+    return GenerationOutput(batch=batch, n_generated=n_gen, input_len=input_len)
 
 
 @jax.jit
@@ -121,9 +144,13 @@ def _trim_to_event(batch: EventStreamBatch, idx: Array) -> EventStreamBatch:
 
 
 def _mask_through_cursor(batch: EventStreamBatch, cursor: Array) -> EventStreamBatch:
-    """Event mask restricted to positions < cursor (hides preallocated tail)."""
+    """Event mask restricted to positions < cursor (hides preallocated tail).
+
+    ``cursor`` may be a scalar (cohort path) or per-row ``(B,)`` (engine
+    slots)."""
     positions = jnp.arange(batch.sequence_length)[None, :]
-    return batch.replace(event_mask=batch.event_mask & (positions < cursor))
+    cur = cursor[:, None] if getattr(cursor, "ndim", 0) == 1 else cursor
+    return batch.replace(event_mask=batch.event_mask & (positions < cur))
 
 
 def generate(
@@ -139,7 +166,8 @@ def generate(
     stopping_criteria: StoppingCriteriaList | None = None,
     do_validate_batch: bool = True,
     mesh: Mesh | None = None,
-) -> EventStreamBatch:
+    return_output: bool = False,
+) -> EventStreamBatch | GenerationOutput:
     """Autoregressively samples future events (reference ``generate`` ``:124``).
 
     Args:
@@ -182,9 +210,13 @@ def generate(
             (``batch_size * num_return_sequences``) must be divisible by the
             mesh's ``data`` axis size.
 
+        return_output: Return a `GenerationOutput` (result batch + per-row
+            ``n_generated`` real-event counts) instead of the bare batch.
+
     Returns:
         The completed `EventStreamBatch` of ``input_len + max_new_events``
-        events (fewer if a stopping criterion fired).
+        events (fewer if a stopping criterion fired) — or a
+        `GenerationOutput` wrapping it when ``return_output`` is set.
     """
     if batch.segment_ids is not None:
         raise NotImplementedError(
@@ -262,7 +294,7 @@ def generate(
     if stopping_criteria is not None:
         if bool(stopping_criteria(batch, n_events=input_len)):
             _check_prompt()
-            return batch
+            return _with_accounting(batch, input_len) if return_output else batch
         if stopping_criteria.max_length is not None:
             bounds.append(stopping_criteria.max_length - input_len)
     if max_new_events is not None:
@@ -307,7 +339,7 @@ def generate(
         _check_prompt()
         raise
     _check_prompt()
-    return result
+    return _with_accounting(result, input_len) if return_output else result
 
 
 def _should_stop(big, cursor, stopping_criteria) -> bool:
@@ -359,7 +391,14 @@ def _model_config_signature(model, config: StructuredTransformerConfig) -> str:
     except TypeError:
         return sig
     if len(_SIG_CACHE) >= 64:
-        _SIG_CACHE.clear()
+        # Overflow is almost always dead weakrefs (eval loops building a
+        # fresh model per batch): evict those first so live models keep
+        # their memoized signatures; a full clear — which forfeits every
+        # live memo — is the last resort only.
+        for dead in [k for k, (r, _) in _SIG_CACHE.items() if r() is None]:
+            del _SIG_CACHE[dead]
+        if len(_SIG_CACHE) >= 64:
+            _SIG_CACHE.clear()
     _SIG_CACHE[key] = (ref, sig)
     return sig
 

@@ -177,8 +177,9 @@ def _functor_elements(
     # New absolute time (minutes since epoch): start_time + duration-so-far +
     # sampled TTE. Durations exclude the filler delta at the prior event.
     positions = jnp.arange(batch.sequence_length)[None, :]
+    prior_cmp = prior_idx[:, None] if getattr(prior_idx, "ndim", 0) == 1 else prior_idx
     deltas_before = jnp.where(
-        (positions < prior_idx) & batch.event_mask, batch.time_delta, 0.0
+        (positions < prior_cmp) & batch.event_mask, batch.time_delta, 0.0
     ).sum(-1)
     start_time = batch.start_time if batch.start_time is not None else jnp.zeros((B,))
     new_time = jnp.where(

@@ -4,13 +4,27 @@ Rebuild of
 ``/root/reference/EventStream/transformer/generation/generation_stopping_criteria.py``:
 an ABC judging whole batches on **event count** (not token count), a
 max-length criterion, and a list combinator.
+
+Two evaluation protocols coexist:
+
+* the reference's **host protocol** (`StoppingCriteria.__call__`): judge the
+  whole batch on host between steps. `generate()` supports it on its slow
+  (per-event Python dispatch) path.
+* the **device protocol** (`DeviceCriterion.row_done`): judge each row from
+  device-resident per-row decode state, inside the jitted decode program —
+  no host sync, rows stop independently. The serving engine
+  (``serving/engine.py``) consumes these; `MaxLengthCriteria` implements
+  both, so one criterion object works on either path.
 """
 
 from __future__ import annotations
 
 import abc
+from typing import Any
 
 from ..data.types import EventStreamBatch
+
+Array = Any
 
 
 class StoppingCriteria(abc.ABC):
@@ -20,8 +34,43 @@ class StoppingCriteria(abc.ABC):
     def __call__(self, batch: EventStreamBatch, **kwargs) -> bool: ...
 
 
-class MaxLengthCriteria(StoppingCriteria):
-    """Stops once the batch holds ``max_length`` events (reference ``:31``)."""
+class DeviceCriterion(abc.ABC):
+    """Per-row, device-evaluable stopping protocol (the engine's fast path).
+
+    ``row_done`` is traced into the jitted decode step once per engine
+    program; it must be a pure jnp function of the given per-row state and
+    return a ``(n_slots,)`` bool array (True = row finished). Criteria that
+    need host data or whole-batch views stay on the host
+    `StoppingCriteria` protocol and the `generate()` slow path.
+    """
+
+    @abc.abstractmethod
+    def row_done(
+        self,
+        *,
+        big: EventStreamBatch,
+        cursor: Array,
+        base_len: Array,
+        n_generated: Array,
+        budget: Array,
+    ) -> Array:
+        """Per-row done verdicts after a completed decode step.
+
+        Args:
+            big: the engine's preallocated content buffer (rows = slots).
+            cursor: ``(S,)`` int32 — events held per row (prompt + written).
+            base_len: ``(S,)`` int32 — prompt events per row.
+            n_generated: ``(S,)`` int32 — REAL generated events per row.
+            budget: ``(S,)`` int32 — per-row ``max_new_events``.
+        """
+
+
+class MaxLengthCriteria(StoppingCriteria, DeviceCriterion):
+    """Stops once the batch holds ``max_length`` events (reference ``:31``).
+
+    On the device protocol the bound applies per row: a row is done when ITS
+    event count reaches ``max_length``, independent of its cohort.
+    """
 
     def __init__(self, max_length: int):
         self.max_length = max_length
@@ -29,6 +78,32 @@ class MaxLengthCriteria(StoppingCriteria):
     def __call__(self, batch: EventStreamBatch, n_events: int | None = None, **kwargs) -> bool:
         n = n_events if n_events is not None else batch.sequence_length
         return n >= self.max_length
+
+    def row_done(self, *, cursor, **kwargs):
+        return cursor >= self.max_length
+
+
+class DeadRowCriteria(DeviceCriterion):
+    """Stops rows whose newest event is a non-event (device protocol only).
+
+    Once a row writes a masked event every later event is masked too
+    (``sample.event_mask`` propagates the previous event's bit), so the row
+    can never produce another real event: decoding it further is pure waste.
+    Semantically loss-free — the skipped steps would have produced only
+    masked padding. This is the engine's answer to cohort rows that are
+    "already done or unpredictable" burning full-horizon decode in
+    ``generate()``.
+    """
+
+    def row_done(self, *, big, cursor, base_len, **kwargs):
+        import jax.numpy as jnp
+
+        from ..ops.tensor_ops import take_event
+
+        last_real = take_event(big.event_mask, cursor - 1)
+        # Only rows that have started generating can be declared dead — the
+        # prompt's own final event is judged by the first decode step.
+        return (~last_real) & (cursor > base_len)
 
 
 class StoppingCriteriaList(list, StoppingCriteria):

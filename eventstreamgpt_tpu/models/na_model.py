@@ -206,6 +206,7 @@ class NAPPTForGenerativeSequenceModeling(nn.Module):
         output_hidden_states: bool = False,
         is_generation: bool = False,
         dep_graph_el_generation_target: int | None = None,
+        last_event_index: Optional[jnp.ndarray] = None,
     ) -> GenerativeSequenceModelOutput:
         encoded = self.encoder(
             batch,
@@ -214,6 +215,7 @@ class NAPPTForGenerativeSequenceModeling(nn.Module):
             output_attentions=output_attentions,
             output_hidden_states=output_hidden_states,
             dep_graph_el_generation_target=dep_graph_el_generation_target,
+            last_event_index=last_event_index,
         )
         output = self.output_layer(
             batch,

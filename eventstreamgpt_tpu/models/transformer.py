@@ -60,7 +60,9 @@ class KVCache:
     ``key``/``value`` have shape ``(B, H, max_len, head_dim)``; ``mask`` is the
     accumulated key-padding mask ``(B, max_len)`` (True = real event) so that
     cached decoding preserves each past position's event-mask bit; ``length``
-    is the number of positions already written (scalar int32).
+    is the number of positions already written — a scalar int32 on the
+    cohort generation path, or a per-row ``(B,)`` vector on the serving
+    engine's slot-decode path (each slot advances its own cursor).
     """
 
     key: Array
@@ -254,7 +256,38 @@ class InnerSelfAttention(nn.Module):
             query, key, value = heads_first(query), heads_first(key), heads_first(value)
 
         present = None
-        if layer_past is not None:
+        if layer_past is not None and getattr(layer_past.length, "ndim", 0) == 1:
+            # Per-row cache cursors (the serving engine's decode slots): each
+            # row writes its single new key/value at its own ``length[b]``.
+            # Decode chunks are one event wide by construction — a multi-event
+            # chunk would need a per-row *range* scatter.
+            if S != 1:
+                raise NotImplementedError(
+                    "Per-row (vector-length) KV caches support single-event decode "
+                    f"chunks only; got a chunk of {S} events."
+                )
+            max_len = layer_past.key.shape[2]
+            start = layer_past.length  # (B,)
+            pos = jnp.arange(max_len)
+            write = pos[None, :] == start[:, None]  # (B, max_len)
+            # key/value are (B, H, 1, D): broadcast over the buffer axis and
+            # write exactly each row's cursor position.
+            new_key = jnp.where(write[:, None, :, None], key, layer_past.key)
+            new_value = jnp.where(write[:, None, :, None], value, layer_past.value)
+            chunk_mask = (
+                attention_mask if attention_mask is not None else jnp.ones((B, S), dtype=bool)
+            )
+            new_mask = jnp.where(write, chunk_mask, layer_past.mask)
+            if use_cache:
+                present = KVCache(key=new_key, value=new_value, mask=new_mask, length=start + S)
+            key, value = new_key, new_value
+            k_positions = pos
+            q_positions = start[:, None] + jnp.arange(q_len)[None, :] + (
+                1 if static_kv_first else 0
+            )  # (B, q_len)
+            valid_k = pos[None, :] < (start[:, None] + S)  # (B, max_len)
+            attention_mask = new_mask
+        elif layer_past is not None:
             # Fixed-buffer cache: write new keys/values (and the chunk's
             # padding-mask bits) at the cursor, then attend over the full
             # buffer with validity masking.
@@ -516,9 +549,15 @@ class InnerSelfAttention(nn.Module):
                 key,
                 preferred_element_type=jnp.float32,
             )
-            mask = causal[None, None]
+            # Scalar-cursor caches give a shared (Q, K) causal plane; per-row
+            # cursors (vector-length caches) a (B, Q, K) one.
+            mask = causal[None, None] if causal.ndim == 2 else causal[:, None]
             if valid_k is not None:
-                mask = mask & valid_k[None, None, None, :]
+                mask = mask & (
+                    valid_k[None, None, None, :]
+                    if valid_k.ndim == 1
+                    else valid_k[:, None, None, :]
+                )
             if segment_ids is not None:
                 if layer_past is not None or static_kv_first:
                     raise ValueError(
@@ -927,6 +966,7 @@ class NestedAttentionPointProcessTransformer(nn.Module):
         output_attentions: bool = False,
         output_hidden_states: bool = False,
         dep_graph_el_generation_target: int | None = None,
+        last_event_index: Array | None = None,
     ) -> TransformerOutputWithPast:
         cfg = self.config
         segment_ids = batch.segment_ids if batch is not None else None
@@ -1059,8 +1099,18 @@ class NestedAttentionPointProcessTransformer(nn.Module):
 
                     def last_el(x):
                         x_last = jax.lax.dynamic_index_in_dim(x, last_pos, axis=2, keepdims=False)
-                        # (B*seq_len, H, hd) -> last event -> (B, H, hd)
-                        x_last = x_last.reshape(bsz, seq_len, n_heads, hd)[:, -1]
+                        # (B*seq_len, H, hd) -> last event -> (B, H, hd).
+                        # ``last_event_index`` overrides the static "last
+                        # position" pick for bucket-padded prompts (serving
+                        # engine prefill): the seed must be the last REAL
+                        # event per row, not the padded tail position.
+                        x_last = x_last.reshape(bsz, seq_len, n_heads, hd)
+                        if last_event_index is None:
+                            x_last = x_last[:, -1]
+                        else:
+                            from ..ops.tensor_ops import take_event
+
+                            x_last = take_event(x_last, last_event_index)
                         buf = jnp.zeros((bsz, n_heads, max_dep_len, hd), dtype=x.dtype)
                         return buf.at[:, :, 0, :].set(x_last)
 

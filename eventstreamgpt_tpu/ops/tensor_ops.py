@@ -342,17 +342,29 @@ def take_event(x: jnp.ndarray, idx) -> jnp.ndarray:
     exactly one position contributes (NaN/inf at the selected position
     are preserved; other positions never multiply in).
 
+    ``idx`` may also be a per-row vector ``(B,)`` (the serving engine's
+    per-slot cursors): row ``b`` then selects ``x[b, idx[b]]``.
+
     Examples:
         >>> import jax.numpy as jnp
         >>> x = jnp.asarray([[[1, 2], [3, 4], [5, 6]], [[7, 8], [9, 10], [11, 12]]])
         >>> take_event(x, jnp.asarray(1))
         Array([[ 3,  4],
                [ 9, 10]], dtype=int32)
+        >>> take_event(x, jnp.asarray([1, 2]))
+        Array([[ 3,  4],
+               [11, 12]], dtype=int32)
     """
     if isinstance(idx, int):
         return x[:, idx]
     length = x.shape[1]
-    oh = (jnp.arange(length) == idx).reshape((1, length) + (1,) * (x.ndim - 2))
+    if getattr(idx, "ndim", 0) == 1:
+        # Per-row indices: one-hot per row, same masked-reduce lowering.
+        oh = (jnp.arange(length)[None, :] == idx[:, None]).reshape(
+            x.shape[:2] + (1,) * (x.ndim - 2)
+        )
+    else:
+        oh = (jnp.arange(length) == idx).reshape((1, length) + (1,) * (x.ndim - 2))
     if x.dtype == jnp.bool_:
         return jnp.any(jnp.logical_and(oh, x), axis=1)
     return jnp.where(oh, x, jnp.zeros((), x.dtype)).sum(axis=1)

@@ -1,0 +1,11 @@
+"""Serving: the continuous-batching generation engine and its scheduler."""
+
+from .engine import GenerationEngine, SlotState  # noqa: F401
+from .scheduler import (  # noqa: F401
+    AdmissionGroup,
+    EngineResult,
+    Request,
+    Scheduler,
+    make_buckets,
+    pow2_ceil,
+)

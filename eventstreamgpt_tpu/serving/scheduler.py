@@ -1,0 +1,212 @@
+"""Host-side scheduling for the continuous-batching generation engine.
+
+The device side (``serving/engine.py``) exposes three compiled programs —
+bucketed prefill+admit, the slot-decode chunk, and finished-row extraction.
+Everything *policy* lives here, on the host, between dispatch chunks:
+
+* a FIFO request queue with monotonically assigned **admission indices**
+  (the engine's determinism contract keys per-request PRNG off the
+  admission index, so results are independent of slot placement and of
+  which other requests happen to be co-resident);
+* **power-of-two prompt buckets**: a prefill program compiles once per
+  bucket length instead of once per distinct prompt length, and the
+  padding waste this trades away is accounted and reported;
+* **admission groups**: free slots at a chunk boundary are refilled in
+  admission order, grouped by bucket and chunked to power-of-two group
+  sizes so prefill dispatch count stays logarithmic in refill burst size;
+* waste accounting for the benchmark report (`padding_report`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Optional
+
+from ..data.types import EventStreamBatch
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``prompt`` is a one-row `EventStreamBatch` (shape ``(1, Lp, M)``); its
+    ``sequence_length`` is the nominal prompt length — trailing masked
+    events inside it are legal and reproduce `generate()`'s cohort-padding
+    semantics for that row. ``key`` overrides the engine's default
+    per-request key (``fold_in(engine_key, admission_index)``).
+    """
+
+    prompt: EventStreamBatch
+    max_new_events: int
+    key: Any = None
+    request_id: Any = None
+    arrival_time: float = 0.0
+
+    # Assigned by the scheduler at submission.
+    admission_index: int = -1
+
+    @property
+    def prompt_len(self) -> int:
+        return self.prompt.sequence_length
+
+
+@dataclasses.dataclass
+class EngineResult:
+    """A finished request: the completed row plus per-request accounting."""
+
+    request_id: Any
+    admission_index: int
+    batch: EventStreamBatch  # one-row host batch, trimmed to ``n_events``
+    prompt_len: int
+    n_events: int  # prompt + written events (the row's final cursor)
+    n_generated: int  # REAL generated events (masked writes excluded)
+    completion_time: float = 0.0
+
+
+def pow2_ceil(n: int) -> int:
+    """The smallest power of two >= n (n >= 1)."""
+    return 1 << (int(n) - 1).bit_length()
+
+
+def make_buckets(min_bucket: int, max_prompt_len: int) -> tuple[int, ...]:
+    """The power-of-two bucket ladder covering ``[1, max_prompt_len]``.
+
+    The top bucket is ``max_prompt_len`` itself (clamped, not rounded up:
+    prompts cannot exceed it, and rounding up would waste cache width the
+    engine doesn't have).
+
+    Examples:
+        >>> make_buckets(4, 24)
+        (4, 8, 16, 24)
+        >>> make_buckets(8, 8)
+        (8,)
+    """
+    buckets = []
+    b = pow2_ceil(min_bucket)
+    while b < max_prompt_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_prompt_len)
+    return tuple(buckets)
+
+
+@dataclasses.dataclass
+class AdmissionGroup:
+    """One prefill dispatch: same-bucket requests onto specific slots."""
+
+    bucket_len: int
+    group_size: int  # compiled program width (>= len(requests))
+    requests: list[Request]
+    slots: list[int]
+
+
+class Scheduler:
+    """FIFO admission policy + bucket/waste accounting for the engine."""
+
+    def __init__(
+        self,
+        n_slots: int,
+        buckets: Iterable[int],
+        group_sizes: Optional[Iterable[int]] = None,
+    ):
+        self.n_slots = n_slots
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if group_sizes is None:
+            gs, g = [], 1
+            while g < n_slots:
+                gs.append(g)
+                g *= 2
+            gs.append(n_slots)
+            group_sizes = gs
+        self.group_sizes = tuple(sorted(set(int(g) for g in group_sizes)))
+        self.queue: list[Request] = []
+        self._next_admission = 0
+        # Padding-waste accounting (events): real prompt events vs the
+        # bucket-padded events the prefill programs actually process.
+        self._prompt_events = 0
+        self._padded_events = 0
+
+    def submit(self, request: Request) -> Request:
+        if request.prompt_len > max(self.buckets):
+            raise ValueError(
+                f"Prompt of {request.prompt_len} events exceeds the largest bucket "
+                f"({max(self.buckets)}); raise the engine's max_prompt_len."
+            )
+        request.admission_index = self._next_admission
+        self._next_admission += 1
+        self.queue.append(request)
+        return request
+
+    @property
+    def pending(self) -> int:
+        return len(self.queue)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(f"No bucket holds a {prompt_len}-event prompt (buckets={self.buckets})")
+
+    def group_size_for(self, n: int) -> int:
+        for g in self.group_sizes:
+            if g >= n:
+                return g
+        return max(self.group_sizes)
+
+    def plan_admissions(self, free_slots: list[int], now: float | None = None) -> list[AdmissionGroup]:
+        """Plans prefill groups for this chunk boundary and dequeues them.
+
+        Takes arrived requests in admission order up to the free-slot count,
+        groups them by bucket, and chunks each bucket run to compiled group
+        sizes. Padding-waste accounting accrues here.
+        """
+        n_take = len(free_slots)
+        if n_take == 0:
+            return []
+        eligible: list[Request] = []
+        rest: list[Request] = []
+        for r in self.queue:
+            if len(eligible) < n_take and (now is None or r.arrival_time <= now):
+                eligible.append(r)
+            else:
+                rest.append(r)
+        if not eligible:
+            return []
+        self.queue = rest
+
+        by_bucket: dict[int, list[Request]] = {}
+        for r in eligible:
+            by_bucket.setdefault(self.bucket_for(r.prompt_len), []).append(r)
+
+        groups: list[AdmissionGroup] = []
+        slot_iter = iter(free_slots)
+        for bucket_len in sorted(by_bucket):
+            reqs = by_bucket[bucket_len]
+            while reqs:
+                # Largest compiled group that is actually full, else the
+                # smallest that fits the remainder (padded rows are inert).
+                fit = [g for g in self.group_sizes if g <= len(reqs)]
+                g = max(fit) if fit else self.group_size_for(len(reqs))
+                take, reqs = reqs[:g], reqs[g:]
+                groups.append(
+                    AdmissionGroup(
+                        bucket_len=bucket_len,
+                        group_size=self.group_size_for(len(take)),
+                        requests=take,
+                        slots=[next(slot_iter) for _ in take],
+                    )
+                )
+                for r in take:
+                    self._prompt_events += r.prompt_len
+                    self._padded_events += bucket_len
+        return groups
+
+    def padding_report(self) -> dict:
+        """Prefill padding waste traded for the bounded program count."""
+        padded = max(self._padded_events, 1)
+        return {
+            "prompt_events": self._prompt_events,
+            "padded_events": self._padded_events,
+            "padding_waste_frac": round(1.0 - self._prompt_events / padded, 4),
+            "buckets": list(self.buckets),
+        }

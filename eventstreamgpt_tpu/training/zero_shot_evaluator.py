@@ -43,41 +43,20 @@ def import_class_from_file(module_path: Path | str, class_name: str):
     return getattr(module, class_name)
 
 
-def get_generative_predictions(
-    model,
-    params,
+def _aggregate_predictions(
+    generated,
+    batch,
     config: StructuredTransformerConfig,
     labeling_function: Labeler,
-    batch,
-    key: jax.Array,
     num_samples: int,
-    max_new_events: int,
-    use_cache: bool = True,
-    mesh=None,
-    do_validate_batch: bool = True,
     return_generated: bool = False,
 ):
-    """Generates, labels, and averages into empirical label probabilities.
+    """Labels a generated batch and averages into empirical probabilities.
 
-    Reference ``:213-276``. Returns ``(StreamClassificationModelOutput-like,
-    frac_unpredictable per original subject)``; subjects with no predictable
-    samples are dropped from preds/labels. With ``return_generated`` the
-    generated batch is appended to the tuple (the zero-shot bench counts
-    generated events from it).
+    The shared tail of both generation paths (cohort ``generate()`` and the
+    serving engine): reference ``:213-276``'s label-and-aggregate logic.
     """
     B = batch.batch_size
-    generated = generate(
-        model,
-        params,
-        batch,
-        config,
-        key,
-        max_new_events=max_new_events,
-        num_return_sequences=num_samples,
-        use_cache=use_cache,
-        mesh=mesh,
-        do_validate_batch=do_validate_batch,
-    )
     empirical_labels, labels_unpredicted = labeling_function(
         generated, input_seq_len=batch.sequence_length
     )
@@ -115,10 +94,138 @@ def get_generative_predictions(
     return output, frac
 
 
+def get_generative_predictions(
+    model,
+    params,
+    config: StructuredTransformerConfig,
+    labeling_function: Labeler,
+    batch,
+    key: jax.Array,
+    num_samples: int,
+    max_new_events: int,
+    use_cache: bool = True,
+    mesh=None,
+    do_validate_batch: bool = True,
+    return_generated: bool = False,
+    engine=None,
+):
+    """Generates, labels, and averages into empirical label probabilities.
+
+    Reference ``:213-276``. Returns ``(StreamClassificationModelOutput-like,
+    frac_unpredictable per original subject)``; subjects with no predictable
+    samples are dropped from preds/labels. With ``return_generated`` the
+    generated batch is appended to the tuple (the zero-shot bench counts
+    generated events from it).
+
+    With ``engine`` (a `serving.GenerationEngine` built on the same
+    model/params/config), generation routes through the continuous-batching
+    engine instead of the cohort ``generate()`` path: one request per
+    (subject, sample) with key ``fold_in(key, row_index)``, dead rows
+    stopping early on device instead of burning the full horizon. The
+    labeling/aggregation tail is identical.
+    """
+    if engine is not None:
+        generated = _generate_via_engine(
+            engine, batch, key, num_samples, max_new_events
+        )
+    else:
+        generated = generate(
+            model,
+            params,
+            batch,
+            config,
+            key,
+            max_new_events=max_new_events,
+            num_return_sequences=num_samples,
+            use_cache=use_cache,
+            mesh=mesh,
+            do_validate_batch=do_validate_batch,
+        )
+    return _aggregate_predictions(
+        generated, batch, config, labeling_function, num_samples, return_generated
+    )
+
+
+def _generate_via_engine(engine, batch, key: jax.Array, num_samples: int, max_new_events: int):
+    """Runs one eval batch's expanded rows through the serving engine.
+
+    Row order and semantics match ``generate(num_return_sequences=
+    num_samples)``: the batch expands in-order, every row keeps its nominal
+    prompt length (rows whose prompts end in padding generate only masked
+    events — the engine just stops decoding them early), and the assembled
+    result has the fixed ``prompt_len + max_new_events`` shape the labeler
+    contract expects. Request keys are ``fold_in(key, row_index)`` — a
+    bit-deterministic function of the eval key and dataset order,
+    independent of slot placement or co-scheduled batches.
+    """
+    from ..serving import Request
+
+    expanded = batch.repeat_batch_elements(num_samples)
+    n_rows = expanded.batch_size
+    prompt_len = batch.sequence_length
+    requests = [
+        Request(
+            prompt=expanded.slice((slice(i, i + 1), slice(None))),
+            max_new_events=max_new_events,
+            key=jax.random.fold_in(key, i),
+            request_id=i,
+        )
+        for i in range(n_rows)
+    ]
+    results = engine.run(requests)
+
+    # Reassemble into the fixed cohort shape; rows stopped early pad out
+    # with masked events exactly where generate() would have written them.
+    target_len = prompt_len + max_new_events
+    M = batch.n_data_elements
+    out = {
+        "event_mask": np.zeros((n_rows, target_len), bool),
+        "time_delta": np.zeros((n_rows, target_len), np.float32),
+        "dynamic_indices": np.zeros((n_rows, target_len, M), np.int64),
+        "dynamic_measurement_indices": np.zeros((n_rows, target_len, M), np.int64),
+        "dynamic_values": np.zeros((n_rows, target_len, M), np.float32),
+        "dynamic_values_mask": np.zeros((n_rows, target_len, M), bool),
+    }
+    for res in results:
+        i = res.request_id
+        row = res.batch
+        n = min(res.n_events, target_len)
+        for field, dst in out.items():
+            src = np.asarray(getattr(row, field))[0, :n]
+            dst[i, :n] = src.astype(dst.dtype)
+    from ..data.types import EventStreamBatch
+
+    return EventStreamBatch(
+        event_mask=out["event_mask"],
+        time_delta=out["time_delta"],
+        static_indices=np.asarray(expanded.static_indices)
+        if expanded.static_indices is not None
+        else None,
+        static_measurement_indices=np.asarray(expanded.static_measurement_indices)
+        if expanded.static_measurement_indices is not None
+        else None,
+        dynamic_indices=out["dynamic_indices"],
+        dynamic_measurement_indices=out["dynamic_measurement_indices"],
+        dynamic_values=out["dynamic_values"],
+        dynamic_values_mask=out["dynamic_values_mask"],
+        start_time=np.asarray(expanded.start_time)
+        if expanded.start_time is not None
+        else None,
+    )
+
+
 def zero_shot_evaluation(
-    cfg: FinetuneConfig, num_samples: int | None = None
+    cfg: FinetuneConfig, num_samples: int | None = None, use_engine: bool = True
 ) -> tuple[dict, dict]:
-    """Runs zero-shot evaluation over tuning + held-out (reference ``:304-391``)."""
+    """Runs zero-shot evaluation over tuning + held-out (reference ``:304-391``).
+
+    Generation routes through the continuous-batching serving engine by
+    default (``serving/engine.py``): per-(subject, sample) requests with
+    ``fold_in`` keys, bucketed prefill, and per-row early stopping — rows
+    whose prompts are padding-short stop on device instead of replaying the
+    full horizon. ``use_engine=False`` keeps the PR4 cohort ``generate()``
+    path (one fused program per cohort shape, whole-batch stopping).
+    """
     np.random.seed(cfg.seed)
     key = jax.random.PRNGKey(cfg.seed)
 
@@ -163,6 +270,26 @@ def zero_shot_evaluation(
     # data mesh so all chips decode (VERDICT r02 missing #1; the reference
     # runs this under Lightning DDP).
     mesh = data_parallel_mesh(batch_size * num_samples)
+
+    engine = None
+    if use_engine:
+        from ..serving import GenerationEngine
+
+        n_slots = batch_size * num_samples
+        engine = GenerationEngine(
+            model,
+            params,
+            config,
+            template=init_batch,
+            n_slots=n_slots,
+            max_len=tuning_pyd.max_seq_len + max_new_events,
+            max_prompt_len=tuning_pyd.max_seq_len,
+            # The engine key only seeds requests submitted WITHOUT explicit
+            # keys; the evaluator always passes explicit fold_in keys. Fold
+            # on a sentinel so the eval key itself is never consumed twice.
+            base_key=jax.random.fold_in(key, 2**31 - 1),
+            mesh=mesh,
+        )
 
     results = {}
     for split, dataset in ((Split.TUNING, tuning_pyd), (Split.HELD_OUT, held_out_pyd)):
@@ -215,6 +342,7 @@ def zero_shot_evaluation(
                     # construction; the device-side validity readback costs
                     # a tunnel round trip per batch.
                     do_validate_batch=device_ds is None,
+                    engine=engine,
                 )
                 if len(out.labels):
                     metrics.update(out)

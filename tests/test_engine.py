@@ -1,0 +1,640 @@
+"""Tests for the continuous-batching generation engine (serving/).
+
+The load-bearing invariants:
+
+* **Parity vs generate()**: a request admitted with key ``k`` reproduces
+  ``generate(..., k, max_new_events=budget)`` with ``B=1`` — bit-exact for
+  the CI model (all fields, including floats), and bit-exact on event
+  structure / integer content for NA with floats at near-ulp tolerance
+  (XLA fuses the engine's one-program walk differently from generate()'s
+  program at tiny CPU widths, reassociating identical math; the
+  op-level scalar-vs-vector cache equivalence below IS bit-exact, pinning
+  that the plumbing — not the math — is the only difference).
+* **Refill-order determinism**: same engine geometry ⇒ results are
+  bitwise independent of admission order, slot placement, co-residents,
+  and decode-chunk size (per-request keys fold in the admission index).
+* **Per-row stopping**: budgets bind per row; dead rows (masked newest
+  event) stop early and the saved decode shows up in the waste stats.
+* The vector-length KV-cache branch equals the scalar branch op-for-op.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.generation import generate
+from eventstreamgpt_tpu.generation.generation_utils import GenerationOutput
+from eventstreamgpt_tpu.generation.stopping_criteria import (
+    DeadRowCriteria,
+    MaxLengthCriteria,
+)
+from eventstreamgpt_tpu.models.ci_model import CIPPTForGenerativeSequenceModeling
+from eventstreamgpt_tpu.models.na_model import NAPPTForGenerativeSequenceModeling
+from eventstreamgpt_tpu.serving import GenerationEngine, Request, Scheduler, make_buckets
+from eventstreamgpt_tpu.serving.scheduler import pow2_ceil
+
+from .test_generation import ci_config, make_prompt, na_config
+
+pytestmark = pytest.mark.slow  # model-building e2e; excluded from tier-1 fast loop
+
+
+MAX_LEN = 8
+
+
+def build(kind: str):
+    config = ci_config() if kind == "ci" else na_config()
+    prompt = make_prompt(B=4, L=4)
+    cls = (
+        CIPPTForGenerativeSequenceModeling
+        if kind == "ci"
+        else NAPPTForGenerativeSequenceModeling
+    )
+    model = cls(config)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    return config, model, params, prompt
+
+
+def engine_for(model, params, config, template, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("min_bucket", 2)
+    return GenerationEngine(
+        model, params, config, template=template, **kw
+    )
+
+
+def mixed_requests(prompt, n=4):
+    """Mixed prompt lengths with complementary budgets (Lp + budget == MAX_LEN,
+    the engine's attention-width parity condition)."""
+    reqs = []
+    for i in range(n):
+        Lp = 3 if i % 2 == 0 else 4
+        row = prompt.slice((slice(i, i + 1), slice(0, Lp)))
+        reqs.append(
+            Request(
+                prompt=row,
+                max_new_events=MAX_LEN - Lp,
+                key=jax.random.fold_in(jax.random.PRNGKey(42), i),
+                request_id=i,
+            )
+        )
+    return reqs
+
+
+def reference_for(model, params, config, req):
+    return generate(
+        model,
+        params,
+        req.prompt,
+        config,
+        req.key,
+        max_new_events=req.max_new_events,
+        return_output=True,
+    )
+
+
+def assert_rows_match(result, ref_out: GenerationOutput, exact_floats: bool):
+    n = result.n_events
+    ref = ref_out.batch
+    np.testing.assert_array_equal(
+        np.asarray(result.batch.event_mask), np.asarray(ref.event_mask)[:, :n]
+    )
+    for f in ("dynamic_indices", "dynamic_measurement_indices", "dynamic_values_mask"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(result.batch, f)), np.asarray(getattr(ref, f))[:, :n]
+        )
+    for f in ("time_delta", "dynamic_values"):
+        a = np.asarray(getattr(result.batch, f))
+        b = np.asarray(getattr(ref, f))[:, :n]
+        if exact_floats:
+            np.testing.assert_array_equal(a, b)
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    # generate() ran the full horizon: anything past the engine's stop must
+    # be masked non-events (the engine only skipped inert padding).
+    assert not np.asarray(ref.event_mask)[:, n:].any()
+    assert result.n_generated == int(ref_out.n_generated[0])
+
+
+class TestSchedulerHost:
+    def test_pow2_ceil_and_buckets(self):
+        assert pow2_ceil(1) == 1 and pow2_ceil(5) == 8 and pow2_ceil(8) == 8
+        assert make_buckets(4, 24) == (4, 8, 16, 24)
+        assert make_buckets(8, 8) == (8,)
+
+    def test_bucket_for_and_padding_report(self):
+        s = Scheduler(4, make_buckets(2, 7))
+        assert s.buckets == (2, 4, 7)
+        assert s.bucket_for(3) == 4 and s.bucket_for(5) == 7
+        prompt = make_prompt(B=1, L=3)
+        s.submit(Request(prompt=prompt, max_new_events=2))
+        groups = s.plan_admissions([0, 1])
+        assert len(groups) == 1 and groups[0].bucket_len == 4
+        rep = s.padding_report()
+        assert rep["prompt_events"] == 3 and rep["padded_events"] == 4
+        assert rep["padding_waste_frac"] == 0.25
+
+    def test_admission_order_and_group_chunking(self):
+        s = Scheduler(8, (4,), group_sizes=(1, 2, 4, 8))
+        prompt = make_prompt(B=1, L=4)
+        for i in range(5):
+            s.submit(Request(prompt=prompt, max_new_events=2, request_id=i))
+        groups = s.plan_admissions(list(range(8)))
+        # 5 same-bucket requests -> one full group of 4 + remainder of 1.
+        assert [len(g.requests) for g in groups] == [4, 1]
+        assert [r.request_id for g in groups for r in g.requests] == [0, 1, 2, 3, 4]
+        assert [r.admission_index for g in groups for r in g.requests] == [0, 1, 2, 3, 4]
+
+    def test_arrival_times_gate_admission(self):
+        s = Scheduler(2, (4,))
+        prompt = make_prompt(B=1, L=4)
+        early = s.submit(Request(prompt=prompt, max_new_events=2, arrival_time=0.0))
+        late = s.submit(Request(prompt=prompt, max_new_events=2, arrival_time=10.0))
+        groups = s.plan_admissions([0, 1], now=1.0)
+        admitted = [r for g in groups for r in g.requests]
+        assert admitted == [early]
+        assert s.pending == 1 and s.queue[0] is late
+
+    def test_oversized_prompt_rejected(self):
+        s = Scheduler(2, (4,))
+        with pytest.raises(ValueError, match="exceeds the largest bucket"):
+            s.submit(Request(prompt=make_prompt(B=1, L=6), max_new_events=1))
+
+
+class TestDeviceCriteria:
+    def test_max_length_row_done(self):
+        crit = MaxLengthCriteria(5)
+        done = crit.row_done(cursor=jnp.asarray([3, 5, 7]))
+        np.testing.assert_array_equal(np.asarray(done), [False, True, True])
+
+    def test_dead_row(self):
+        batch = make_prompt(B=2, L=4)
+        batch = batch.replace(
+            event_mask=jnp.asarray([[True, True, True, False], [True, True, True, True]])
+        )
+        done = DeadRowCriteria().row_done(
+            big=batch, cursor=jnp.asarray([4, 4]), base_len=jnp.asarray([2, 2])
+        )
+        np.testing.assert_array_equal(np.asarray(done), [True, False])
+        # Rows still inside their prompt are never declared dead.
+        done = DeadRowCriteria().row_done(
+            big=batch, cursor=jnp.asarray([4, 4]), base_len=jnp.asarray([4, 4])
+        )
+        np.testing.assert_array_equal(np.asarray(done), [False, False])
+
+
+class TestCIParity:
+    def setup_method(self):
+        self.config, self.model, self.params, self.prompt = build("ci")
+
+    def test_bit_exact_vs_generate(self):
+        """Mixed prompt lengths, bucket-padded prefill, grouped admissions —
+        every request reproduces its B=1 generate() run bit-for-bit."""
+        engine = engine_for(self.model, self.params, self.config, self.prompt)
+        reqs = mixed_requests(self.prompt)
+        results = engine.run(reqs)
+        assert [r.admission_index for r in results] == [0, 1, 2, 3]
+        for res, req in zip(results, reqs):
+            assert_rows_match(
+                res, reference_for(self.model, self.params, self.config, req), True
+            )
+
+    def test_refill_and_slot_count_determinism(self):
+        """Same geometry ⇒ results independent of admission order and
+        scheduling; chunk size is also invariant (same scan body)."""
+        reqs = mixed_requests(self.prompt)
+        base = engine_for(self.model, self.params, self.config, self.prompt).run(reqs)
+
+        def rerun(**kw):
+            eng = engine_for(self.model, self.params, self.config, self.prompt, **kw)
+            return eng.run(list(reversed(mixed_requests(self.prompt))))
+
+        for kw in ({"decode_chunk": 3}, {"decode_chunk": 2}):
+            redo = {r.request_id: r for r in rerun(**kw)}
+            for res in base:
+                other = redo[res.request_id]
+                assert res.n_events == other.n_events
+                for f in ("event_mask", "time_delta", "dynamic_indices", "dynamic_values"):
+                    np.testing.assert_array_equal(
+                        np.asarray(getattr(res.batch, f)),
+                        np.asarray(getattr(other.batch, f)),
+                    )
+
+    def test_per_row_budgets_stop_rows_independently(self):
+        engine = engine_for(self.model, self.params, self.config, self.prompt)
+        reqs = [
+            Request(
+                prompt=self.prompt.slice((slice(i, i + 1), slice(0, 4))),
+                max_new_events=b,
+                key=jax.random.fold_in(jax.random.PRNGKey(3), i),
+                request_id=i,
+            )
+            for i, b in enumerate((1, 2, 4))
+        ]
+        results = engine.run(reqs)
+        assert [r.n_events - r.prompt_len for r in results] == [1, 2, 4]
+
+    def test_dead_rows_stop_early(self):
+        """A prompt whose final event is padding can never generate a real
+        event; the engine stops it after one probe step instead of burning
+        the full budget (generate() decodes the whole horizon)."""
+        padded = self.prompt.replace(
+            event_mask=self.prompt.event_mask.at[0, 2:].set(False)
+        )
+        engine = engine_for(self.model, self.params, self.config, self.prompt)
+        key = jax.random.PRNGKey(5)
+        res = engine.run(
+            [
+                Request(prompt=padded.slice((slice(0, 1), slice(0, 4))), max_new_events=4, key=key, request_id=0),
+            ]
+        )[0]
+        assert res.n_generated == 0
+        assert res.n_events < 8  # stopped before the full budget
+        ref = generate(
+            self.model,
+            self.params,
+            padded.slice((slice(0, 1), slice(0, 4))),
+            self.config,
+            key,
+            max_new_events=4,
+            return_output=True,
+        )
+        assert int(ref.n_generated[0]) == 0
+        # Content parity over the events the engine did write.
+        assert not np.asarray(ref.batch.event_mask)[:, res.n_events :].any()
+
+    def test_padded_prompt_matches_generate_semantics(self):
+        """A bucket-padded prompt (nominal length > real events) reproduces
+        generate() on the same padded prompt — cohort-padding semantics."""
+        padded = self.prompt.replace(
+            event_mask=self.prompt.event_mask.at[1, 3:].set(False)
+        )
+        row = padded.slice((slice(1, 2), slice(0, 4)))
+        key = jax.random.PRNGKey(9)
+        engine = engine_for(self.model, self.params, self.config, self.prompt)
+        res = engine.run([Request(prompt=row, max_new_events=4, key=key, request_id=0)])[0]
+        ref = reference_for(
+            self.model, self.params, self.config,
+            Request(prompt=row, max_new_events=4, key=key),
+        )
+        assert_rows_match(res, ref, True)
+
+    def test_wasted_decode_accounting(self):
+        engine = engine_for(self.model, self.params, self.config, self.prompt)
+        engine.run(mixed_requests(self.prompt))
+        stats = engine.stats()
+        assert stats["slot_steps"] > 0
+        assert 0.0 <= stats["wasted_decode_frac"] < 1.0
+        assert stats["active_slot_steps"] <= stats["slot_steps"]
+        assert stats["padding_waste_frac"] > 0  # Lp=3 rows padded to bucket 4
+
+
+class TestLocalAttentionParity:
+    """Sliding-window attention is position-based (`k > q - window`), so it
+    is THE detector for cache-position drift: if bucket-padding holes ever
+    occupied cache slots, the window would count them as history and real
+    events would fall out — a ~1e-3 divergence on this shape. Admission
+    therefore sets per-row cache cursors to the TRUE prompt length (holes
+    are overwritten, positions stay contiguous with generate()'s) — pinned
+    here for bucket-padded prompts on the default-style alternating
+    local/global stack at near-ulp float tolerance (the windowed einsum
+    fuses differently in the engine's program; integer content and event
+    structure stay exact), four orders of magnitude tighter than the
+    failure mode it guards."""
+
+    def test_bucket_padded_prompts_bit_exact_under_local_window(self):
+        from eventstreamgpt_tpu.models.config import StructuredTransformerConfig
+
+        from .test_generation import BASE_KWARGS, MEASUREMENT_CONFIGS
+
+        config = StructuredTransformerConfig(
+            measurement_configs=dict(MEASUREMENT_CONFIGS),
+            **{
+                **BASE_KWARGS,
+                "seq_attention_types": ["local", "global"],
+                "seq_window_size": 2,
+            },
+        )
+        prompt = make_prompt(B=4, L=4)
+        model = CIPPTForGenerativeSequenceModeling(config)
+        params = model.init(jax.random.PRNGKey(0), prompt)
+        engine = engine_for(model, params, config, prompt)
+        reqs = mixed_requests(prompt)  # Lp=3 rows bucket-pad to 4
+        for res, req in zip(engine.run(reqs), reqs):
+            assert_rows_match(res, reference_for(model, params, config, req), False)
+
+
+class TestEngineRunModes:
+    """The benchmark-facing run modes: accounting-only harvest, reset-with-
+    compiled-programs, and the Poisson-arrival latency replay."""
+
+    def setup_method(self):
+        self.config, self.model, self.params, self.prompt = build("ci")
+
+    def test_reset_determinism_and_accounting_harvest(self):
+        engine = engine_for(self.model, self.params, self.config, self.prompt)
+        reqs = lambda: [  # noqa: E731 — default keys: fold_in(admission index)
+            Request(
+                prompt=self.prompt.slice((slice(i, i + 1), slice(0, 4))),
+                max_new_events=3,
+                request_id=i,
+            )
+            for i in range(3)
+        ]
+        first = engine.run(reqs(), fetch_results=False)
+        assert all(r.batch is None for r in first)  # accounting only
+        assert all(r.n_events == 7 for r in first)
+        n_programs = len(engine._prefill_jits)
+        engine.reset()
+        assert engine.occupied == 0 and engine.scheduler.pending == 0
+        second = engine.run(reqs(), fetch_results=False)
+        # Same admission indices -> same fold_in keys -> identical outcomes,
+        # and reset kept every compiled prefill program.
+        assert [r.n_generated for r in first] == [r.n_generated for r in second]
+        assert len(engine._prefill_jits) == n_programs
+
+    def test_arrival_time_replay_orders_completions(self):
+        engine = engine_for(self.model, self.params, self.config, self.prompt)
+        engine.scheduler.group_sizes = (1,)
+        reqs = [
+            Request(
+                prompt=self.prompt.slice((slice(i, i + 1), slice(0, 4))),
+                max_new_events=2,
+                request_id=i,
+                arrival_time=0.05 * i,
+            )
+            for i in range(3)
+        ]
+        results = engine.run(reqs, use_arrival_times=True, fetch_results=False)
+        assert len(results) == 3
+        for r in results:
+            assert r.completion_time >= reqs[r.request_id].arrival_time
+        # A request cannot complete before a request that arrived long
+        # before it was even admitted finished being served.
+        by_id = {r.request_id: r for r in results}
+        assert by_id[0].completion_time <= by_id[2].completion_time
+
+
+class TestNAParity:
+    def setup_method(self):
+        self.config, self.model, self.params, self.prompt = build("na")
+
+    def test_parity_vs_generate(self):
+        """NA: event structure and integer content bit-exact; floats at
+        near-ulp tolerance (one-program fusion reassociates identical math
+        at tiny widths — see TestVectorCacheBranch for the op-level
+        bit-exactness of the plumbing itself)."""
+        engine = engine_for(self.model, self.params, self.config, self.prompt)
+        reqs = mixed_requests(self.prompt)
+        for res, req in zip(engine.run(reqs), reqs):
+            assert_rows_match(
+                res, reference_for(self.model, self.params, self.config, req), False
+            )
+
+    def test_refill_order_determinism(self):
+        reqs = mixed_requests(self.prompt)
+        base = {
+            r.request_id: r
+            for r in engine_for(self.model, self.params, self.config, self.prompt).run(reqs)
+        }
+        redo = {
+            r.request_id: r
+            for r in engine_for(self.model, self.params, self.config, self.prompt).run(
+                list(reversed(mixed_requests(self.prompt)))
+            )
+        }
+        for i, res in base.items():
+            for f in ("event_mask", "time_delta", "dynamic_indices", "dynamic_values"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(res.batch, f)),
+                    np.asarray(getattr(redo[i].batch, f)),
+                )
+
+
+class TestMeshShardedEngine:
+    def test_slots_shard_over_data_mesh(self):
+        """Engine state shards over the virtual mesh's data axis; results
+        keep the event structure and integer content of the unsharded run
+        (floats may differ at ulp across SPMD partitionings)."""
+        from eventstreamgpt_tpu.training.sharding import make_mesh
+
+        config, model, params, prompt = build("ci")
+        mesh = make_mesh(2, 1)
+        reqs = mixed_requests(prompt)
+        base = engine_for(model, params, config, prompt).run(mixed_requests(prompt))
+        sharded = engine_for(model, params, config, prompt, mesh=mesh).run(reqs)
+        for a, b in zip(base, sharded):
+            assert a.n_events == b.n_events and a.n_generated == b.n_generated
+            np.testing.assert_array_equal(
+                np.asarray(a.batch.event_mask), np.asarray(b.batch.event_mask)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(a.batch.dynamic_indices), np.asarray(b.batch.dynamic_indices)
+            )
+            np.testing.assert_allclose(
+                np.asarray(a.batch.time_delta),
+                np.asarray(b.batch.time_delta),
+                rtol=1e-5,
+                atol=1e-6,
+            )
+
+    def test_indivisible_slots_rejected(self):
+        from eventstreamgpt_tpu.training.sharding import make_mesh
+
+        config, model, params, prompt = build("ci")
+        with pytest.raises(ValueError, match="must divide"):
+            engine_for(model, params, config, prompt, n_slots=3, mesh=make_mesh(2, 1))
+
+
+class TestVectorCacheBranch:
+    """The per-row (vector-length) KV-cache branch is op-for-op bit-exact
+    against the scalar branch — evaluated eagerly, outside any fusion."""
+
+    def test_na_walk_scalar_vs_vector_lengths_bitwise(self):
+        config, model, params, prompt = build("na")
+        row = prompt.slice((slice(0, 1), slice(None)))
+        from eventstreamgpt_tpu.generation.generation_utils import (
+            _build_na_steps,
+            _preallocate,
+            _slice_preds_at,
+            _trim_to_event,
+        )
+        from eventstreamgpt_tpu.models.transformer import NAPast
+
+        steps = _build_na_steps(model, config, B=1, input_len=4, max_new_events=2)
+        big = _preallocate(row, 2)
+        cursor = jnp.asarray(4, jnp.int32)
+        key = jax.random.PRNGKey(11)
+        past = None
+        n_levels = len(steps["measurements_to_fill_list"])
+        for level in range(n_levels):
+            key, sk = jax.random.split(key)
+            if level == 0:
+                preds, past = steps["prefix_step"](params, big)
+                preds_last = _slice_preds_at(preds, cursor - 1)
+                big = steps["do_append"](params, big, preds_last, cursor, sk)
+            else:
+                preds, past = steps["target_steps"][level](params, big, past, cursor)
+                preds_last = _slice_preds_at(preds, jnp.asarray(0))
+                big = steps["do_fills"][level](params, big, preds_last, cursor + 1, sk)
+        cursor = cursor + 1
+
+        vec_past = NAPast(
+            seq_past=tuple(
+                kv.replace(length=jnp.full((1,), kv.length, jnp.int32))
+                for kv in past.seq_past
+            ),
+            dep_graph_past=past.dep_graph_past,
+        )
+        for target, view_at in ((0, cursor - 1), (1, cursor), (2, cursor)):
+            view = _trim_to_event(big, view_at)
+            out_s = model.apply(
+                params, view, past=past, use_cache=True, is_generation=True,
+                dep_graph_el_generation_target=target,
+            )
+            out_v = model.apply(
+                params, view, past=vec_past, use_cache=True, is_generation=True,
+                dep_graph_el_generation_target=target,
+            )
+            for a, b in zip(
+                jax.tree_util.tree_leaves(out_s.preds),
+                jax.tree_util.tree_leaves(out_v.preds),
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestGenerationOutput:
+    def test_per_row_n_generated(self):
+        """Rows stopping at different lengths report different counts: a row
+        whose prompt ends in padding generates 0 real events while full rows
+        generate the whole budget."""
+        config, model, params, prompt = build("ci")
+        padded = prompt.replace(event_mask=prompt.event_mask.at[1, 2:].set(False))
+        out = generate(
+            model,
+            params,
+            padded,
+            config,
+            jax.random.PRNGKey(1),
+            max_new_events=3,
+            return_output=True,
+        )
+        assert isinstance(out, GenerationOutput)
+        n = np.asarray(out.n_generated)
+        assert out.input_len == 4
+        assert n.shape == (4,)
+        assert n[1] == 0 and (n[[0, 2, 3]] == 3).all()
+        # Accounting matches the batch itself.
+        np.testing.assert_array_equal(
+            n, np.asarray(out.batch.event_mask)[:, 4:].sum(axis=1)
+        )
+
+
+class TestSigCacheEviction:
+    def test_dead_refs_evicted_before_clear(self):
+        from eventstreamgpt_tpu.generation import generation_utils as gu
+
+        class Obj:
+            pass
+
+        gu._SIG_CACHE.clear()
+        keep = Obj()
+        cfg = ci_config()
+        gu._model_config_signature(keep, cfg)
+        dead = [Obj() for _ in range(63)]  # fill to the 64-entry threshold
+        for o in dead:
+            gu._model_config_signature(o, cfg)
+        assert len(gu._SIG_CACHE) == 64
+        del dead, o  # drop the only strong refs -> 63 dead weakrefs
+        probe = Obj()
+        gu._model_config_signature(probe, cfg)  # triggers overflow handling
+        # Dead entries were evicted; the live `keep` memo survived.
+        assert id(keep) in gu._SIG_CACHE
+        assert gu._SIG_CACHE[id(keep)][0]() is keep
+        assert len(gu._SIG_CACHE) == 2  # keep + probe
+
+    def test_full_clear_is_last_resort(self):
+        from eventstreamgpt_tpu.generation import generation_utils as gu
+
+        class Obj:
+            pass
+
+        gu._SIG_CACHE.clear()
+        cfg = ci_config()
+        live = [Obj() for _ in range(64)]  # strong refs: nothing evictable
+        for o in live:
+            gu._model_config_signature(o, cfg)
+        assert len(gu._SIG_CACHE) == 64
+        probe = Obj()
+        gu._model_config_signature(probe, cfg)
+        # Nothing was dead, so the memo fell back to a full clear + insert.
+        assert len(gu._SIG_CACHE) == 1
+        assert id(probe) in gu._SIG_CACHE
+
+
+class TestEvaluatorThroughEngine:
+    def test_engine_evaluator_matches_per_row_generate(self):
+        """The evaluator's engine path computes the same predictions (and so
+        the same AUROC inputs) as per-row generate() with the same fold_in
+        keys — the aggregation tail is shared code."""
+        from eventstreamgpt_tpu.training.zero_shot_evaluator import (
+            _aggregate_predictions,
+            get_generative_predictions,
+        )
+        from eventstreamgpt_tpu.models.zero_shot_labeler import Labeler
+
+        config, model, params, prompt = build("ci")
+        config.finetuning_task = "task"
+        config.num_labels = 2
+        config.id2label = {0: False, 1: True}
+
+        class CountLabeler(Labeler):
+            def __call__(self, batch, input_seq_len):
+                future = np.asarray(batch.event_mask)[:, input_seq_len:]
+                pos = future.sum(axis=1) >= 2
+                labels = np.zeros((len(pos), 2), np.float32)
+                labels[np.arange(len(pos)), pos.astype(np.int64)] = 1.0
+                return labels, np.zeros(len(pos), bool)
+
+        labeler = CountLabeler(config=config)
+        batch = prompt.replace(
+            stream_labels={"task": jnp.asarray([0, 1, 0, 1])},
+            event_mask=prompt.event_mask.at[2, 3:].set(False),  # one short row
+        )
+        key = jax.random.PRNGKey(17)
+        num_samples, budget = 2, 4
+
+        engine = GenerationEngine(
+            model, params, config, template=prompt, n_slots=4, max_len=MAX_LEN,
+            decode_chunk=2, min_bucket=4,
+        )
+        out_e, frac_e = get_generative_predictions(
+            model, params, config, labeler, batch, key,
+            num_samples=num_samples, max_new_events=budget, engine=engine,
+        )
+
+        # Reference: per-row generate() with the engine's key derivation,
+        # assembled into the same cohort shape, aggregated identically.
+        expanded = batch.repeat_batch_elements(num_samples)
+        rows = []
+        for i in range(expanded.batch_size):
+            gen = generate(
+                model,
+                params,
+                expanded.slice((slice(i, i + 1), slice(None))),
+                config,
+                jax.random.fold_in(key, i),
+                max_new_events=budget,
+            )
+            rows.append(gen)
+        ref_generated = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0), *rows
+        )
+        out_r, frac_r = _aggregate_predictions(
+            ref_generated, batch, config, labeler, num_samples
+        )
+        np.testing.assert_array_equal(out_e.preds, out_r.preds)
+        np.testing.assert_array_equal(out_e.labels, out_r.labels)
+        np.testing.assert_array_equal(frac_e, frac_r)

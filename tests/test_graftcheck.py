@@ -635,3 +635,22 @@ class TestLoweredProgramGates:
         text = fn.lower(*args).as_text()
         assert check_no_f64(text, "pretrain:na_dp8") == []
         assert check_no_host_transfers(text, "pretrain:na_dp8") == []
+
+    def test_engine_programs_are_f64_and_host_transfer_free(self):
+        """The serving engine's slot-decode + bucketed-prefill programs on
+        the dp8 mesh: per-row stopping is judged ON DEVICE, so the decode
+        program must carry no host callbacks (a smuggled sync would
+        resurrect the per-event readback continuous batching removes), and
+        neither program may introduce f64."""
+        from eventstreamgpt_tpu.analysis.program_checks import (
+            canonical_engine_programs,
+            check_no_f64,
+            check_no_host_transfers,
+        )
+
+        programs = canonical_engine_programs(8)
+        assert set(programs) == {"decode", "prefill_b8"}
+        for label, (fn, args) in programs.items():
+            text = fn.lower(*args).as_text()
+            assert check_no_f64(text, f"engine:{label}") == []
+            assert check_no_host_transfers(text, f"engine:{label}") == []
