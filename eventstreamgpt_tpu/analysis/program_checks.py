@@ -40,6 +40,7 @@ __all__ = [
     "canonical_finetune_step",
     "canonical_generation_program",
     "canonical_engine_programs",
+    "canonical_service_programs",
     "check_no_f64",
     "check_no_host_transfers",
     "check_collective_budget",
@@ -225,6 +226,54 @@ def canonical_engine_programs(n_data: int = 8) -> dict:
     return engine.aot_programs(bucket_len=8, group=2)
 
 
+def canonical_service_programs(n_data: int = 8) -> dict:
+    """The online serving service's dispatch programs on the dp8 mesh
+    (``serving/service.py``): a 2-replica service whose replicas shard
+    their slots data-parallel over the virtual mesh.
+
+    The service dispatches exactly the engine's compiled programs — the
+    slot-decode chunk, bucketed prefill, and the boundary pack (the packed
+    done-mask/accounting array whose host copy is the ONLY device->host
+    traffic of the serving loop, started async at dispatch), plus replica
+    1's differently-chunked decode program (``decode_r1`` — both replicas'
+    hot loops get the f64/host-transfer gates; replica 0's additionally
+    gates against the committed ``service_dp8`` collective budget). Pins
+    the service hot path f64-free and host-transfer-free beyond that one
+    designed fetch. Returns label -> (jitted fn, args).
+    """
+    import jax
+
+    from ..serving import GenerationEngine, ServingService
+    from ..training.sharding import make_mesh
+
+    ge = _graft_entry()
+    _require_devices(n_data)
+    mesh = make_mesh(n_data, 1)
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+
+    def replica(chunk):
+        return GenerationEngine(
+            model,
+            params,
+            model.config,
+            template=batch,
+            n_slots=2 * n_data,
+            max_len=12,
+            decode_chunk=chunk,
+            dispatch_depth=2,
+            min_bucket=8,
+            mesh=mesh,
+        )
+
+    # Replica 0 uses a distinct decode_chunk from the engine canonical so
+    # the gated program is a genuinely different compile, not a cache hit.
+    service = ServingService(
+        [replica(4), replica(2)], prefill_budget_events=32
+    )
+    return service.aot_programs(bucket_len=8, group=2)
+
+
 # ------------------------------------------------------------------- checks
 def check_no_f64(program_text: str, label: str = "program") -> list[str]:
     """No f64 element types anywhere in the lowered/compiled module."""
@@ -321,6 +370,13 @@ def run_program_checks(
     # committed collective budget below.
     for label, (fn, args) in canonical_engine_programs(8).items():
         programs[f"engine:{label}"] = (fn, args)
+    # The online service's dispatch programs (2-replica service over dp8,
+    # deeper decode chunk): the service hot path must stay host-transfer-
+    # free beyond the one async boundary fetch — a callback smuggled into
+    # decode, prefill, or the boundary pack would re-serialize the
+    # double-buffered pipeline.
+    for label, (fn, args) in canonical_service_programs(8).items():
+        programs[f"service:{label}"] = (fn, args)
 
     lowered = {}
     for label, (fn, args) in programs.items():
@@ -339,6 +395,9 @@ def run_program_checks(
         budget_keys["pretrain:na_dp8"] = "na_dp8"
         budget_keys["engine:decode"] = "engine_dp8"
         budget_keys["engine:prefill_b8"] = "engine_prefill_dp8"
+        budget_keys["service:decode"] = "service_dp8"
+        budget_keys["service:prefill_b8"] = "service_prefill_dp8"
+        budget_keys["service:boundary_pack"] = "service_boundary_dp8"
         for label, budget_key in budget_keys.items():
             log(f"compiling {label} for the collective budget gate")
             compiled = lowered[label].compile()

@@ -1,7 +1,8 @@
-"""Serving: the continuous-batching generation engine and its scheduler."""
+"""Serving: the continuous-batching engine, and the online service over it."""
 
 from .engine import GenerationEngine, SlotState  # noqa: F401
 from .scheduler import (  # noqa: F401
+    AdmissionRejected,
     AdmissionGroup,
     EngineResult,
     Request,
@@ -9,3 +10,5 @@ from .scheduler import (  # noqa: F401
     make_buckets,
     pow2_ceil,
 )
+from .service import ServiceResult, ServingService, latency_quantiles  # noqa: F401
+from .slo import BATCH, DEFAULT_LANES, INTERACTIVE, LaneConfig, LaneQueues  # noqa: F401

@@ -25,6 +25,21 @@ engine replaces cohorts with a fixed set of decode **slots**:
   (or the request's own key), and each slot's key chain splits exactly like
   ``generate()``'s — results are **bit-deterministic under any refill
   order, slot placement, and co-resident set** (rows never mix in any op).
+* the chunk-boundary done-mask readback is **non-blocking**: the packed
+  ``(4, n_slots)`` boundary array is computed on device at dispatch and its
+  host copy started immediately (``copy_to_host_async``); it is resolved
+  one-or-more chunks later (``dispatch_depth`` chunks may be in flight), so
+  host admission planning, bucketing, and refill fully overlap device
+  decode and the readback leaves the critical path. Because a finished
+  slot's row is frozen by the ``where(active)`` merges, harvesting from a
+  stale boundary is content-exact — results are bitwise invariant to
+  ``dispatch_depth``. The only stale-host-view cost is that a freed slot
+  refills up to ``dispatch_depth - 1`` chunks later. Boundaries resolve
+  strictly FIFO (the in-flight queue enforces issue order), and each slot
+  carries an admission **epoch** (the chunk count at its prefill dispatch)
+  so a boundary issued *before* a slot's current request was admitted can
+  never harvest that request — the in-order-resolution assumption the
+  synchronous loop silently relied on is now an explicit check.
 
 Determinism / parity contract: a request admitted with key ``k`` produces
 the same trajectory as ``generate(model, params, prompt, config, k,
@@ -44,6 +59,7 @@ from __future__ import annotations
 
 import functools
 import time
+from collections import deque
 from typing import Any, Optional, Sequence
 
 import jax
@@ -131,6 +147,17 @@ class GenerationEngine:
             must fit. Also the KV-cache width (see the parity contract).
         decode_chunk: decode steps per dispatch; the done-mask readback
             happens once per chunk.
+        dispatch_depth: decode chunks in flight before the oldest boundary
+            readback is resolved. 1 reproduces the synchronous PR-5
+            schedule (issue, then resolve the same chunk's boundary —
+            though the copy still starts at dispatch); 2 (the default)
+            double-buffers: while the device decodes chunk N+1, the host
+            resolves chunk N's boundary, harvests, and plans refills.
+            Results are bitwise invariant to this knob (frozen-row
+            harvests); only refill latency and waste accounting move.
+        max_queue: optional bound on the host admission queue
+            (`scheduler.Scheduler` ``max_pending``) — submit raises
+            `AdmissionRejected` when full (reject-new backpressure).
         max_prompt_len: top prefill bucket (default ``max_len - 1``).
         min_bucket: smallest prefill bucket.
         base_key: engine PRNG key; request keys default to
@@ -154,6 +181,8 @@ class GenerationEngine:
         n_slots: int,
         max_len: int,
         decode_chunk: int = 8,
+        dispatch_depth: int = 2,
+        max_queue: Optional[int] = None,
         max_prompt_len: int | None = None,
         min_bucket: int = 8,
         base_key: Optional[jax.Array] = None,
@@ -167,6 +196,9 @@ class GenerationEngine:
         self.n_slots = int(n_slots)
         self.max_len = int(max_len)
         self.decode_chunk = int(decode_chunk)
+        self.dispatch_depth = int(dispatch_depth)
+        if self.dispatch_depth < 1:
+            raise ValueError("dispatch_depth must be >= 1")
         self.max_prompt_len = int(max_prompt_len or (max_len - 1))
         if self.max_prompt_len >= self.max_len:
             raise ValueError("max_prompt_len must leave room to generate (< max_len)")
@@ -194,7 +226,9 @@ class GenerationEngine:
         )
 
         self.scheduler = Scheduler(
-            self.n_slots, make_buckets(min_bucket, self.max_prompt_len)
+            self.n_slots,
+            make_buckets(min_bucket, self.max_prompt_len),
+            max_pending=max_queue,
         )
 
         self._template = self._normalize_prompt(template)
@@ -211,11 +245,31 @@ class GenerationEngine:
         )
         self._prefill_jits: dict[tuple[int, int], Any] = {}
         self._extract_jits: dict[int, Any] = {}
+        # Packs done/cursor/base_len/n_generated into ONE (4, n_slots)
+        # array so the boundary readback is a single async host copy.
+        self._pack_boundary_jit = jax.jit(
+            lambda st: jnp.stack(
+                [
+                    st.done.astype(jnp.int32),
+                    st.cursor,
+                    st.base_len,
+                    st.n_generated,
+                ]
+            )
+        )
 
         # Host-side slot table: slot -> Request or None. `live`/`done` on
         # device gate compute; occupancy/harvest bookkeeping lives here.
+        # `_slot_epoch[s]` is the value of `_dispatched_chunks` when slot
+        # s's current request was admitted: a boundary packed at chunk
+        # index c reflects that admission iff epoch < c (the prefill was
+        # enqueued before chunk c) — the guard that makes stale-boundary
+        # harvests safe under pipelined dispatch.
         self._table: list[Optional[Request]] = [None] * self.n_slots
+        self._slot_epoch: list[int] = [0] * self.n_slots
         self._dispatched_chunks = 0
+        self._resolved_chunks = 0
+        self._inflight: deque[tuple[int, Any]] = deque()
 
     # ------------------------------------------------------------ state init
     def _normalize_prompt(self, batch: EventStreamBatch) -> EventStreamBatch:
@@ -709,15 +763,25 @@ class GenerationEngine:
         )
         for r, s in zip(group.requests, group.slots):
             self._table[s] = r
+            self._slot_epoch[s] = self._dispatched_chunks
 
     def _harvest(
-        self, boundary: np.ndarray, now: float, fetch_results: bool
+        self, boundary: np.ndarray, chunk_index: int, now: float, fetch_results: bool
     ) -> list[EngineResult]:
-        """``boundary`` is the chunk's single packed readback (see run()):
-        rows [done, cursor, base_len, n_generated], each ``(n_slots,)``."""
+        """``boundary`` is one chunk's single packed readback (see
+        `issue_chunk`): rows [done, cursor, base_len, n_generated], each
+        ``(n_slots,)``, packed right after chunk ``chunk_index`` was
+        dispatched. Only slots whose current request was admitted BEFORE
+        that chunk (`_slot_epoch` < ``chunk_index``) are harvested — a
+        pipelined boundary predates any newer admission into a recycled
+        slot, and its stale done bit must not harvest the new tenant."""
         done_np = boundary[0].astype(bool)
         finished = [
-            s for s in range(self.n_slots) if self._table[s] is not None and done_np[s]
+            s
+            for s in range(self.n_slots)
+            if self._table[s] is not None
+            and done_np[s]
+            and self._slot_epoch[s] < chunk_index
         ]
         if not finished:
             return []
@@ -789,59 +853,104 @@ class GenerationEngine:
     def occupied(self) -> int:
         return sum(t is not None for t in self._table)
 
+    @property
+    def inflight_chunks(self) -> int:
+        """Decode chunks dispatched whose boundary has not been resolved."""
+        return len(self._inflight)
+
+    def free_slots(self) -> list[int]:
+        """Slot indices with no resident request (host view — a slot that
+        finished on device stays occupied until its boundary resolves)."""
+        return [s for s in range(self.n_slots) if self._table[s] is None]
+
+    def plan_and_dispatch(
+        self, now: float | None = None, max_padded_events: int | None = None
+    ) -> int:
+        """Plans admissions for the current free slots and dispatches the
+        prefill groups; returns the number of requests admitted.
+        ``max_padded_events`` is the per-boundary prefill budget (prefill/
+        decode disaggregation — see `scheduler.Scheduler.plan_admissions`)."""
+        free = self.free_slots()
+        if not free or not self.scheduler.pending:
+            return 0
+        groups = self.scheduler.plan_admissions(
+            free, now=now, max_padded_events=max_padded_events
+        )
+        for g in groups:
+            self._dispatch_group(g)
+        return sum(len(g.requests) for g in groups)
+
+    def issue_chunk(self) -> None:
+        """Dispatches one decode chunk and starts its boundary readback.
+
+        The packed ``(4, n_slots)`` boundary (done mask + per-slot
+        accounting — ONE small device->host copy per chunk) is computed on
+        device immediately after the decode dispatch and its host copy
+        started with ``copy_to_host_async``; nothing blocks. The boundary
+        queues on `_inflight` (strict FIFO: boundaries resolve in issue
+        order regardless of when their copies land)."""
+        self._state = self._decode_jit(self.params, self._state)
+        self._dispatched_chunks += 1
+        boundary = self._pack_boundary_jit(self._state)
+        try:
+            boundary.copy_to_host_async()
+        except AttributeError:  # older jax Array impls: resolve() blocks
+            pass
+        self._inflight.append((self._dispatched_chunks, boundary))
+
+    def resolve_chunk(self, now: float, fetch_results: bool = True) -> list[EngineResult]:
+        """Resolves the OLDEST in-flight boundary and harvests its finished
+        rows. Blocks only if that boundary's async copy has not landed yet
+        (in steady state it has — the device raced ahead)."""
+        chunk_index, boundary = self._inflight.popleft()
+        host = np.asarray(boundary)  # graftcheck: allow GC001 -- chunk-boundary readback by design (async copy started at dispatch)
+        self._resolved_chunks += 1
+        return self._harvest(host, chunk_index, now, fetch_results)
+
     def run(
         self,
         requests: Sequence[Request] = (),
         *,
         use_arrival_times: bool = False,
         fetch_results: bool = True,
+        max_padded_events: int | None = None,
     ) -> list[EngineResult]:
         """Drains the queue (plus ``requests``) to completion.
 
-        With ``use_arrival_times`` the loop replays each request's
-        ``arrival_time`` (seconds, relative) against a wall clock — the
-        Poisson-arrival latency benchmark mode; ``completion_time`` on each
-        result is measured on the same clock. ``fetch_results=False`` skips
-        the finished-row content transfer (results carry accounting only) —
-        the offline-throughput benchmark mode.
+        The dispatch loop is pipelined: up to ``dispatch_depth`` decode
+        chunks are issued before the oldest boundary readback is resolved,
+        so host harvest/refill planning overlaps device decode (results are
+        bitwise identical at any depth; depth 1 reproduces the synchronous
+        PR-5 schedule). With ``use_arrival_times`` the loop replays each
+        request's ``arrival_time`` (seconds, relative) against a wall clock
+        — the Poisson-arrival latency benchmark mode; ``completion_time``
+        on each result is measured on the same clock. ``fetch_results=
+        False`` skips the finished-row content transfer (results carry
+        accounting only) — the offline-throughput benchmark mode.
+        ``max_padded_events`` caps per-boundary prefill admission work.
         """
         for r in requests:
             self.submit(r)
         results: list[EngineResult] = []
         t0 = time.perf_counter()
 
-        while self.scheduler.pending or self.occupied:
+        while self.scheduler.pending or self.occupied or self._inflight:
             now = time.perf_counter() - t0
-            free = [s for s in range(self.n_slots) if self._table[s] is None]
-            groups = self.scheduler.plan_admissions(
-                free, now=now if use_arrival_times else None
+            self.plan_and_dispatch(
+                now=now if use_arrival_times else None,
+                max_padded_events=max_padded_events,
             )
-            for g in groups:
-                self._dispatch_group(g)
-            if self.occupied == 0:
-                if self.scheduler.pending:
-                    time.sleep(1e-3)  # waiting on arrivals
+            if self.occupied:
+                self.issue_chunk()
+                if len(self._inflight) < self.dispatch_depth and self.occupied:
+                    # Keep the pipe full before paying a resolve.
                     continue
-                break
-            self._state = self._decode_jit(self.params, self._state)
-            self._dispatched_chunks += 1
-            # The chunk-boundary readback the design budgets for: ONE small
-            # device->host copy per dispatched chunk. Done mask AND the
-            # per-slot accounting vectors ride the same packed array, so the
-            # accounting-only harvest needs no second transfer.
-            boundary = np.asarray(  # graftcheck: allow GC001 -- chunk-boundary readback by design
-                jnp.stack(
-                    [
-                        self._state.done.astype(jnp.int32),
-                        self._state.cursor,
-                        self._state.base_len,
-                        self._state.n_generated,
-                    ]
+            if self._inflight:
+                results.extend(
+                    self.resolve_chunk(time.perf_counter() - t0, fetch_results)
                 )
-            )
-            results.extend(
-                self._harvest(boundary, time.perf_counter() - t0, fetch_results)
-            )
+            elif self.scheduler.pending:
+                time.sleep(1e-3)  # waiting on arrivals
         return sorted(results, key=lambda r: r.admission_index)
 
     def reset(self) -> None:
@@ -855,9 +964,15 @@ class GenerationEngine:
         if self.mesh is not None:
             self._state = jax.device_put(self._state, self._state_shardings())
         self._table = [None] * self.n_slots
+        self._slot_epoch = [0] * self.n_slots
         self._dispatched_chunks = 0
+        self._resolved_chunks = 0
+        self._inflight.clear()
         self.scheduler = Scheduler(
-            self.n_slots, self.scheduler.buckets, group_sizes=self.scheduler.group_sizes
+            self.n_slots,
+            self.scheduler.buckets,
+            group_sizes=self.scheduler.group_sizes,
+            max_pending=self.scheduler.max_pending,
         )
 
     # ---------------------------------------------------------- accounting
@@ -869,7 +984,9 @@ class GenerationEngine:
             {
                 "n_slots": self.n_slots,
                 "decode_chunk": self.decode_chunk,
+                "dispatch_depth": self.dispatch_depth,
                 "dispatched_chunks": self._dispatched_chunks,
+                "resolved_chunks": self._resolved_chunks,
                 "slot_steps": total,
                 "active_slot_steps": active,
                 "wasted_decode_frac": round(1.0 - active / max(total, 1), 4),
@@ -903,4 +1020,7 @@ class GenerationEngine:
                 self._prefill_jit(bucket_len, group),
                 (self.params, self._state, pbig, plen, budgets, keys, slots),
             ),
+            # The boundary pack is the only program between decode and the
+            # host: it must stay a pure pack (no host callbacks, no f64).
+            "boundary_pack": (self._pack_boundary_jit, (self._state,)),
         }

@@ -4,10 +4,17 @@ The device side (``serving/engine.py``) exposes three compiled programs —
 bucketed prefill+admit, the slot-decode chunk, and finished-row extraction.
 Everything *policy* lives here, on the host, between dispatch chunks:
 
-* a FIFO request queue with monotonically assigned **admission indices**
-  (the engine's determinism contract keys per-request PRNG off the
-  admission index, so results are independent of slot placement and of
-  which other requests happen to be co-resident);
+* a **bounded** FIFO request queue with monotonically assigned
+  **admission indices** (the engine's determinism contract keys
+  per-request PRNG off the admission index, so results are independent of
+  slot placement and of which other requests happen to be co-resident).
+  Backpressure policy: when ``max_pending`` is set and the queue is full,
+  ``submit`` **rejects the new request** (`AdmissionRejected`) instead of
+  growing without bound or dropping admitted work — rejected requests
+  never receive an admission index, so the admitted set's key derivation
+  (and therefore every admitted result) is unchanged by rejections. Queue
+  depth, high-water depth, and the reject count surface in
+  ``padding_report``;
 * **power-of-two prompt buckets**: a prefill program compiles once per
   bucket length instead of once per distinct prompt length, and the
   padding waste this trades away is accounted and reported;
@@ -23,6 +30,15 @@ import dataclasses
 from typing import Any, Iterable, Optional
 
 from ..data.types import EventStreamBatch
+
+
+class AdmissionRejected(RuntimeError):
+    """The bounded admission queue is full; the request was NOT enqueued.
+
+    The reject-new policy is deliberate: dropping *admitted* work would
+    change which requests hold which admission indices and thereby the
+    PRNG keys of everything behind them; rejecting at the door leaves the
+    admitted set — and every admitted result — bit-identical."""
 
 
 @dataclasses.dataclass
@@ -108,6 +124,7 @@ class Scheduler:
         n_slots: int,
         buckets: Iterable[int],
         group_sizes: Optional[Iterable[int]] = None,
+        max_pending: Optional[int] = None,
     ):
         self.n_slots = n_slots
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
@@ -119,12 +136,18 @@ class Scheduler:
             gs.append(n_slots)
             group_sizes = gs
         self.group_sizes = tuple(sorted(set(int(g) for g in group_sizes)))
+        self.max_pending = None if max_pending is None else int(max_pending)
         self.queue: list[Request] = []
         self._next_admission = 0
         # Padding-waste accounting (events): real prompt events vs the
         # bucket-padded events the prefill programs actually process.
         self._prompt_events = 0
         self._padded_events = 0
+        # Backpressure accounting: rejected submissions, queue high-water
+        # mark, and admissions deferred by a prefill budget cap.
+        self._rejected = 0
+        self._max_depth = 0
+        self._prefill_deferrals = 0
 
     def submit(self, request: Request) -> Request:
         if request.prompt_len > max(self.buckets):
@@ -132,9 +155,16 @@ class Scheduler:
                 f"Prompt of {request.prompt_len} events exceeds the largest bucket "
                 f"({max(self.buckets)}); raise the engine's max_prompt_len."
             )
+        if self.max_pending is not None and len(self.queue) >= self.max_pending:
+            self._rejected += 1
+            raise AdmissionRejected(
+                f"admission queue full ({len(self.queue)}/{self.max_pending}); "
+                "rejecting the new request (reject-new policy, see AdmissionRejected)"
+            )
         request.admission_index = self._next_admission
         self._next_admission += 1
         self.queue.append(request)
+        self._max_depth = max(self._max_depth, len(self.queue))
         return request
 
     @property
@@ -153,20 +183,46 @@ class Scheduler:
                 return g
         return max(self.group_sizes)
 
-    def plan_admissions(self, free_slots: list[int], now: float | None = None) -> list[AdmissionGroup]:
+    def plan_admissions(
+        self,
+        free_slots: list[int],
+        now: float | None = None,
+        max_padded_events: Optional[int] = None,
+    ) -> list[AdmissionGroup]:
         """Plans prefill groups for this chunk boundary and dequeues them.
 
         Takes arrived requests in admission order up to the free-slot count,
         groups them by bucket, and chunks each bucket run to compiled group
         sizes. Padding-waste accounting accrues here.
+
+        ``max_padded_events`` caps the bucket-padded prefill work admitted
+        at this boundary (the prefill/decode disaggregation budget): once
+        the cumulative bucket cost of taken requests would exceed the cap,
+        the remainder stays queued for later boundaries — FIFO order is
+        preserved (no overtaking past a deferred head), and at least one
+        request is always taken when any is eligible, so a single oversized
+        prompt cannot livelock admission. Deferrals are counted
+        (``prefill_deferrals`` in `padding_report`).
         """
         n_take = len(free_slots)
         if n_take == 0:
             return []
         eligible: list[Request] = []
         rest: list[Request] = []
+        budget_left = max_padded_events
+        budget_exhausted = False
         for r in self.queue:
-            if len(eligible) < n_take and (now is None or r.arrival_time <= now):
+            arrived = now is None or r.arrival_time <= now
+            if len(eligible) < n_take and arrived and not budget_exhausted:
+                if budget_left is not None:
+                    cost = self.bucket_for(r.prompt_len)
+                    if eligible and cost > budget_left:
+                        # Defer — and everything behind it too (strict FIFO).
+                        budget_exhausted = True
+                        self._prefill_deferrals += 1
+                        rest.append(r)
+                        continue
+                    budget_left -= cost
                 eligible.append(r)
             else:
                 rest.append(r)
@@ -202,11 +258,16 @@ class Scheduler:
         return groups
 
     def padding_report(self) -> dict:
-        """Prefill padding waste traded for the bounded program count."""
+        """Prefill padding waste traded for the bounded program count, plus
+        the admission-queue backpressure counters."""
         padded = max(self._padded_events, 1)
         return {
             "prompt_events": self._prompt_events,
             "padded_events": self._padded_events,
             "padding_waste_frac": round(1.0 - self._prompt_events / padded, 4),
             "buckets": list(self.buckets),
+            "queue_depth": len(self.queue),
+            "max_queue_depth": self._max_depth,
+            "rejected_total": self._rejected,
+            "prefill_deferrals": self._prefill_deferrals,
         }

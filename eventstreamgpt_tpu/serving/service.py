@@ -1,0 +1,330 @@
+"""Online serving service: multi-replica SLO scheduling over the engine.
+
+``GenerationEngine`` (PR 5) is one device's continuous-batching loop; this
+module is the *service* in front of it — the layer ROADMAP item 1 names on
+the way to "millions of users":
+
+* **Shared admission queue with SLO lanes** (`serving/slo.py`): every
+  request enters through a latency-class lane (``interactive`` drains
+  first; ``batch`` gets a reserved ``min_share`` so skewed traffic cannot
+  starve it). Lanes are bounded; a full lane **rejects the new request**
+  (counted, surfaced in `stats`) instead of growing host memory without
+  limit — the documented backpressure contract.
+* **Multi-replica dispatch**: N engine replicas (data-parallel over a
+  mesh, or round-robin on one device for CI) drain the one shared queue.
+  Placement is **budget-aware**: each admitted request goes to the replica
+  with the least outstanding decode work (sum of resident + queued
+  ``max_new_events``), ties to the lowest replica index — deterministic.
+* **Async double-buffered dispatch**: each replica runs the engine's
+  pipelined hooks (``issue_chunk`` / ``resolve_chunk``): chunk N+1's
+  decode is dispatched before chunk N's done mask is read (the boundary
+  copy was started at dispatch with ``copy_to_host_async``), so host
+  admission, bucketing, and refill planning fully overlap device decode.
+* **Prefill/decode disaggregation**: per boundary, each replica admits at
+  most ``prefill_budget_events`` bucket-padded prefill events
+  (`Scheduler.plan_admissions` budget cap) — a burst of long prompts
+  spreads across boundaries as an interleaved budget-capped stream
+  instead of head-of-line-blocking in-flight decode.
+
+Determinism contract (the PR 5 contract, end to end): the service assigns
+every **accepted** request its PRNG key at accept time —
+``fold_in(service_key, admission_index)``, exactly the engine's
+derivation, with admission indices assigned in accept order. Engine
+results are bitwise functions of (prompt, budget, key, ``max_len``) only,
+so service results are **bit-identical to the synchronous single engine**
+for the same accepted request set — regardless of replica placement, lane
+routing, dispatch overlap depth, prefill budgeting, or chunk size.
+Replicas must share ``max_len`` (the attention-width parity condition);
+slot counts and chunk sizes may differ freely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional, Sequence, Union
+
+import jax
+
+from ..data.types import EventStreamBatch
+from .engine import GenerationEngine, _as_raw_key
+from .scheduler import EngineResult, Request
+from .slo import DEFAULT_LANES, INTERACTIVE, LaneConfig, LaneQueues
+
+
+@dataclasses.dataclass
+class ServiceResult:
+    """A finished service request: the engine result plus service routing
+    metadata, on the service's arrival→completion clock."""
+
+    request_id: Any  # the caller's id (the service keys internally)
+    lane: str
+    replica: int
+    admission_index: int  # service-global accept index (the PRNG fold)
+    batch: Optional[EventStreamBatch]
+    prompt_len: int
+    n_events: int
+    n_generated: int
+    arrival_time: float
+    completion_time: float
+
+    @property
+    def latency(self) -> float:
+        return self.completion_time - self.arrival_time
+
+
+def latency_quantiles(results: Sequence[ServiceResult]) -> dict:
+    """Per-lane (and overall) p50/p95 latency in ms — the bench helper."""
+    out: dict = {}
+    by_lane: dict[str, list[float]] = {}
+    for r in results:
+        by_lane.setdefault(r.lane, []).append(1000.0 * r.latency)
+    for lane, xs in list(by_lane.items()) + [
+        ("overall", [1000.0 * r.latency for r in results])
+    ]:
+        xs = sorted(xs)
+        if not xs:
+            continue
+        out[lane] = {
+            "p50_ms": xs[len(xs) // 2],
+            "p95_ms": xs[min(int(len(xs) * 0.95), len(xs) - 1)],
+        }
+    return out
+
+
+class ServingService:
+    """SLO-aware online serving over one or more engine replicas.
+
+    Args:
+        replicas: `GenerationEngine` instances. All must be idle, share
+            ``max_len`` (attention-width parity — the determinism
+            contract), and have no engine-level ``max_queue`` (the
+            service's lanes own backpressure; double bounding would
+            reject deterministically-admitted work mid-placement).
+        lanes: `LaneConfig` set; defaults to ``interactive`` + ``batch``
+            (batch reserved 25% of each admission round).
+        base_key: service PRNG key. Accepted request i (with no explicit
+            key) runs with ``fold_in(base_key, i)`` — identical to a
+            single engine constructed with this ``base_key`` serving the
+            same requests in the same order.
+        prefill_budget_events: per-replica, per-boundary cap on
+            bucket-padded prefill events (prefill/decode disaggregation).
+            ``None`` = unlimited (prefill bursts may stall decode).
+        default_lane: lane used when ``submit``/``run`` get no lane.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[GenerationEngine],
+        *,
+        lanes: Sequence[LaneConfig] = DEFAULT_LANES,
+        base_key: Optional[jax.Array] = None,
+        prefill_budget_events: Optional[int] = None,
+        default_lane: str = INTERACTIVE,
+    ):
+        self.replicas = list(replicas)
+        if not self.replicas:
+            raise ValueError("at least one engine replica is required")
+        if len({id(e) for e in self.replicas}) != len(self.replicas):
+            raise ValueError("replicas must be distinct engine instances")
+        max_lens = {e.max_len for e in self.replicas}
+        if len(max_lens) != 1:
+            raise ValueError(
+                f"replicas must share max_len (attention-width parity; the "
+                f"determinism contract) — got {sorted(max_lens)}"
+            )
+        for i, e in enumerate(self.replicas):
+            if e.occupied or e.scheduler.pending or e.inflight_chunks:
+                raise ValueError(f"replica {i} is not idle")
+            if e.scheduler.max_pending is not None:
+                raise ValueError(
+                    f"replica {i} has an engine-level max_queue; the service's "
+                    "lanes own backpressure — construct replicas without it"
+                )
+        self.max_len = self.replicas[0].max_len
+        self.lanes = LaneQueues(lanes)
+        if default_lane not in self.lanes.configs:
+            raise ValueError(f"default_lane {default_lane!r} is not a configured lane")
+        self.default_lane = default_lane
+        self.prefill_budget_events = prefill_budget_events
+        if base_key is None:
+            base_key = jax.random.PRNGKey(0)
+        self._base_key = _as_raw_key(base_key)
+        self._next_index = 0
+        # internal index -> routing metadata (lane, caller id, arrival,
+        # budget, replica once placed).
+        self._meta: dict[int, dict] = {}
+        # Outstanding decode work per replica (resident + engine-queued
+        # budgets) — the budget-aware placement key.
+        self._outstanding = [0] * len(self.replicas)
+
+    # ------------------------------------------------------------ admission
+    def _request_key(self, index: int):
+        # Byte-identical to GenerationEngine._request_key's default.
+        return _as_raw_key(jax.random.fold_in(self._base_key, index))
+
+    def submit(self, request: Request, lane: Optional[str] = None) -> bool:
+        """Offers a request to a lane. True ⇒ accepted (an admission index
+        and PRNG key are now bound); False ⇒ rejected by lane backpressure
+        (counted in `stats`; the request holds no index, so the admitted
+        set's results are unchanged)."""
+        lane = lane or self.default_lane
+        if request.max_new_events < 1:
+            raise ValueError("max_new_events must be >= 1")
+        if request.prompt_len + request.max_new_events > self.max_len:
+            raise ValueError(
+                f"prompt ({request.prompt_len}) + budget ({request.max_new_events}) "
+                f"exceeds max_len ({self.max_len})"
+            )
+        # Reject BEFORE binding an index: a rejected request must not
+        # perturb the admitted set's key derivation.
+        if lane not in self.lanes.configs:
+            raise KeyError(f"unknown lane {lane!r}")
+        cfg = self.lanes.configs[lane]
+        if cfg.max_pending is not None and self.lanes.depth(lane) >= cfg.max_pending:
+            self.lanes.offer(request, lane)  # counts the reject, won't enqueue
+            return False
+        index = self._next_index
+        self._next_index += 1
+        internal = dataclasses.replace(request, request_id=index)
+        if internal.key is None:
+            internal.key = self._request_key(index)
+        accepted = self.lanes.offer(internal, lane)
+        assert accepted  # bound was checked above
+        self._meta[index] = {
+            "lane": lane,
+            "request_id": request.request_id,
+            "arrival": request.arrival_time,
+            "budget": request.max_new_events,
+            "replica": None,
+        }
+        return True
+
+    # ------------------------------------------------------------ placement
+    def _place(self) -> None:
+        """Budget-aware placement of lane picks onto replica queues.
+
+        Capacity per replica = free slots minus its engine-queued backlog
+        (placed-but-deferred prefills hold future slots). Each pick goes to
+        the replica with the least outstanding decode budget (ties: lowest
+        index) — deterministic, and irrelevant to result content."""
+        capacity = [
+            max(len(e.free_slots()) - e.scheduler.pending, 0) for e in self.replicas
+        ]
+        picks = self.lanes.pick(sum(capacity))
+        for lane, req in picks:
+            ri = min(
+                (i for i in range(len(self.replicas)) if capacity[i] > 0),
+                key=lambda i: (self._outstanding[i], i),
+            )
+            self._meta[req.request_id]["replica"] = ri
+            self._outstanding[ri] += req.max_new_events
+            capacity[ri] -= 1
+            self.replicas[ri].submit(req)
+
+    def _wrap(self, er: EngineResult, ri: int) -> ServiceResult:
+        meta = self._meta.pop(er.request_id)
+        self._outstanding[ri] -= meta["budget"]
+        return ServiceResult(
+            request_id=meta["request_id"],
+            lane=meta["lane"],
+            replica=ri,
+            admission_index=er.request_id,
+            batch=er.batch,
+            prompt_len=er.prompt_len,
+            n_events=er.n_events,
+            n_generated=er.n_generated,
+            arrival_time=meta["arrival"],
+            completion_time=er.completion_time,
+        )
+
+    # -------------------------------------------------------------- serving
+    def run(
+        self,
+        requests: Sequence[Union[Request, tuple[Request, str]]] = (),
+        *,
+        use_arrival_times: bool = False,
+        fetch_results: bool = True,
+    ) -> list[ServiceResult]:
+        """Serves ``requests`` (each a `Request` or ``(Request, lane)``) to
+        completion and returns `ServiceResult`s in admission order.
+
+        Without ``use_arrival_times`` everything is submitted up front
+        (lane bounds apply to the whole set). With it, the sequence is a
+        replay trace (``arrival_time`` nondecreasing): each request is
+        offered to its lane when it *arrives* on the service clock, so
+        backpressure rejects reflect instantaneous queue depth — the
+        Poisson-replay benchmark mode. Rejected requests simply don't
+        appear in the results (count in `stats`).
+        """
+        trace: list[tuple[Request, str]] = [
+            r if isinstance(r, tuple) else (r, self.default_lane) for r in requests
+        ]
+        if not use_arrival_times:
+            for req, lane in trace:
+                self.submit(req, lane)
+            trace = []
+        results: list[ServiceResult] = []
+        t0 = time.perf_counter()
+        ptr = 0
+
+        def busy() -> bool:
+            return (
+                ptr < len(trace)
+                or self.lanes.pending > 0
+                or any(e.occupied or e.scheduler.pending or e.inflight_chunks for e in self.replicas)
+            )
+
+        while busy():
+            now = time.perf_counter() - t0
+            while ptr < len(trace) and trace[ptr][0].arrival_time <= now:
+                self.submit(*trace[ptr])
+                ptr += 1
+            self._place()
+            progressed = False
+            for ri, eng in enumerate(self.replicas):
+                eng.plan_and_dispatch(max_padded_events=self.prefill_budget_events)
+                if eng.occupied:
+                    eng.issue_chunk()
+                    progressed = True
+                if eng.inflight_chunks and (
+                    eng.inflight_chunks >= eng.dispatch_depth or not eng.occupied
+                ):
+                    for er in eng.resolve_chunk(
+                        time.perf_counter() - t0, fetch_results
+                    ):
+                        results.append(self._wrap(er, ri))
+                    progressed = True
+            if not progressed:
+                time.sleep(1e-3)  # waiting on arrivals
+        return sorted(results, key=lambda r: r.admission_index)
+
+    # ------------------------------------------------------------ accounting
+    def stats(self) -> dict:
+        """Service-level accounting: lane backpressure counters plus each
+        replica's engine stats and outstanding-budget placement state."""
+        report = self.lanes.report()
+        report.update(
+            {
+                "n_replicas": len(self.replicas),
+                "prefill_budget_events": self.prefill_budget_events,
+                "outstanding_budget": list(self._outstanding),
+                "replicas": [e.stats() for e in self.replicas],
+            }
+        )
+        return report
+
+    # -------------------------------------------------- AOT (graftcheck B)
+    def aot_programs(self, bucket_len: int | None = None, group: int = 1) -> dict:
+        """Every replica's dispatch programs — the service dispatches
+        exactly the engine's compiled programs, so Tier B gates the
+        service path by gating these on the mesh. Replica 0 contributes
+        decode / prefill / boundary pack; further replicas contribute
+        their (differently-configured) decode programs as ``decode_r{i}``
+        so no replica's hot loop escapes the f64/host-transfer gates."""
+        programs = dict(self.replicas[0].aot_programs(bucket_len=bucket_len, group=group))
+        for i, eng in enumerate(self.replicas[1:], start=1):
+            programs[f"decode_r{i}"] = eng.aot_programs(
+                bucket_len=bucket_len, group=group
+            )["decode"]
+        return programs

@@ -649,8 +649,27 @@ class TestLoweredProgramGates:
         )
 
         programs = canonical_engine_programs(8)
-        assert set(programs) == {"decode", "prefill_b8"}
+        assert set(programs) == {"decode", "prefill_b8", "boundary_pack"}
         for label, (fn, args) in programs.items():
             text = fn.lower(*args).as_text()
             assert check_no_f64(text, f"engine:{label}") == []
             assert check_no_host_transfers(text, f"engine:{label}") == []
+
+    def test_service_programs_are_f64_and_host_transfer_free(self):
+        """The online service's dispatch programs (2-replica service over
+        dp8): the async double-buffered pipeline is only host-transfer-free
+        beyond the boundary fetch if decode, prefill, AND the boundary pack
+        carry no callbacks — a smuggled sync in any of them re-serializes
+        the overlap the service exists to create."""
+        from eventstreamgpt_tpu.analysis.program_checks import (
+            canonical_service_programs,
+            check_no_f64,
+            check_no_host_transfers,
+        )
+
+        programs = canonical_service_programs(8)
+        assert set(programs) == {"decode", "prefill_b8", "boundary_pack", "decode_r1"}
+        for label, (fn, args) in programs.items():
+            text = fn.lower(*args).as_text()
+            assert check_no_f64(text, f"service:{label}") == []
+            assert check_no_host_transfers(text, f"service:{label}") == []
