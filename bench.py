@@ -38,6 +38,13 @@ Sections:
     interleave, interactive/batch SLO lanes — reporting per-class
     ``service_p50/p95_latency_ms``, ``service_vs_engine_p95_ratio``
     against the synchronous engine arm, and ``service_reject_frac``
+  * r09 kernel-round levers, each with its own A/B on identical work
+    (parity gated in tier-1, speed decided here): the hand-tiled Pallas
+    dep-graph attention kernel vs the r06 fused-XLA formulation
+    (``dep_graph_pallas_ab_ms``), the fused sampling tail vs the r07
+    multi-op tail (``sampling_fused_ab_ms``), and the int8 KV-cache decode
+    arm (``kvq_engine_events_per_sec_per_chip`` + the allocation-free
+    capacity verdict ``kvq_slots_per_chip_ratio``)
   * zero-shot end-to-end (VERDICT r05 #7): the composed generate → label →
     aggregate path on the shipped high-utilization task semantics with
     resident prompts — wall/subject, generated events/s/chip, AUROC,
@@ -501,6 +508,12 @@ def main():
     for arm, overrides in (
         ("unfused_attention", {"dep_graph_fused_attention": False}),
         ("full_plane_heads", {"head_narrow_projections": False}),
+        # r09 lever: the hand-tiled Pallas dep-graph kernel (the default
+        # arm resolves impl=auto -> the kernel on TPU) vs the r06 fused-XLA
+        # formulation pinned explicitly. Parity is gated in tier-1
+        # (tests/test_pallas_dep_graph.py); this arm is the step-level
+        # speed verdict that picks the production impl.
+        ("dep_graph_xla_fused", {"dep_graph_attention_impl": "xla"}),
     ):
         # Derived from the default arm's config so the architectures cannot
         # drift apart — each arm differs in exactly its one override.
@@ -735,6 +748,65 @@ def main():
     gen_arm_rate = gen_arm_useful / max(gen_arm_wall, 1e-9) / n_devices
     gen_arm_slot_steps = len(eng_cohorts) * BATCH * GEN_NEW
     generate_wasted_frac = 1.0 - gen_arm_useful / max(gen_arm_slot_steps, 1)
+
+    # ---- r09 per-lever engine A/Bs. Each arm re-runs the IDENTICAL offline
+    # request set through an engine that flips exactly one lever against
+    # the arm above (the production default: fused sampling tail, float
+    # cache), warm-run first so compiles stay untimed — mirroring the NA
+    # per-lever discipline ("microbenches pick candidates; step A/Bs pick
+    # defaults", r06). The parity side of each lever is gated in tier-1
+    # (tests/test_fused_sampling.py, tests/test_kv_quant.py); these keys
+    # are the measured speed/capacity verdicts.
+    def timed_engine_arm(arm_engine):
+        arm_engine.run(eng_requests(), fetch_results=False)  # warm/compile
+        arm_engine.reset()
+        rtt = _rtt_ms()
+        t0 = time.perf_counter()
+        res = arm_engine.run(eng_requests(), fetch_results=False)
+        raw = time.perf_counter() - t0
+        wall = max(raw - arm_engine._dispatched_chunks * rtt / 1000.0, 1e-9)
+        return wall, int(sum(r.n_generated for r in res))
+
+    def engine_variant(**kw):
+        return GenerationEngine(
+            model,
+            state.params,
+            config,
+            template=eng_cohorts[0],
+            n_slots=BATCH,
+            max_len=SEQ_LEN,
+            decode_chunk=ENGINE_CHUNK,
+            dispatch_depth=1,
+            max_prompt_len=SEQ_LEN - GEN_NEW,
+            min_bucket=32,
+            base_key=jax.random.PRNGKey(11),
+            mesh=mesh,
+            **kw,
+        )
+
+    tunnel_probe("engine_ab", extras)
+    # Sampling-tail A/B: the fused filter+gumbel+argmax tail (the arm
+    # above — impl auto resolves to the Pallas kernel on a single-chip
+    # mesh) vs the r07 multi-op reference tail. Bit-exact outputs either
+    # way (unfiltered), so the delta is pure sampling-tail cost.
+    multiop_wall_s, multiop_useful = timed_engine_arm(
+        engine_variant(sampling_impl="multi_op")
+    )
+    sampling_fused_ab_ms = {
+        "fused_tail_default": round(1000.0 * engine_wall_s, 1),
+        "multi_op_tail": round(1000.0 * multiop_wall_s, 1),
+    }
+
+    # Quantized-cache arm: int8 KV planes + per-head-per-row scales. The
+    # throughput delta is the decode-bandwidth side of the lever; the
+    # capacity side (slots/chip at a 16 GB HBM budget) comes from the
+    # engine's allocation-free slots_report and is what actually caps
+    # production batch size.
+    kvq_engine = engine_variant(kv_cache_dtype="int8")
+    kvq_wall_s, kvq_useful = timed_engine_arm(kvq_engine)
+    kvq_rate = kvq_useful / kvq_wall_s / n_devices
+    kvq_slots = kvq_engine.slots_report()
+    kvq_slots_ratio = kvq_slots["slots_per_chip_ratio_vs_bf16"]
 
     # Poisson-arrival latency replay at ~70% of measured offline capacity.
     # Trickle arrivals admit single requests, so pin group size 1 and warm
@@ -1070,6 +1142,18 @@ def main():
                 # waste the engine's trimmed prompts never pay.
                 "engine_cohort_alive_frac": round(float(np.mean(eng_alive)), 4),
                 "engine_latency_arrival_rate_per_s": round(0.7 * req_rate, 3),
+                # r09 engine-lever detail (headline A/B keys in the tail
+                # block): sampling-tail impl and the per-dtype KV-cache
+                # footprint behind the kvq_* capacity keys.
+                "engine_sampling_impl": eng_stats["sampling_impl"],
+                "kvq_bytes_per_slot_int8": kvq_slots["per_dtype"]["int8"][
+                    "kv_bytes_per_slot"
+                ],
+                "kvq_bytes_per_slot_bf16": kvq_slots["per_dtype"]["bf16"][
+                    "kv_bytes_per_slot"
+                ],
+                "kvq_useful_events": kvq_useful,
+                "kvq_offline_wall_s": round(kvq_wall_s, 3),
                 # Online serving service detail (r08): geometry and per-lane
                 # latency behind the headline service_* keys in the tail.
                 "service_replicas": 1,
@@ -1109,6 +1193,20 @@ def main():
                 # (probe/probe minimums on the same resident batch).
                 "na_fused_ab_probe_ms": {k: round(v, 2) for k, v in na_ab_ms.items()},
                 "na_vs_ci_probe_step_ratio": round(na_probe_ms / padded_probe_ms, 2),
+                # r09 lever 1: the hand-tiled Pallas dep-graph kernel vs the
+                # r06 fused-XLA formulation, measured at the step level on
+                # the same resident batch — the winner names the production
+                # impl (`dep_graph_attention_impl`; parity gated in tier-1).
+                "dep_graph_pallas_ab_ms": {
+                    "pallas_kernel_default": round(na_ab_ms["fused_narrow_default"], 2),
+                    "xla_fused": round(na_ab_ms["dep_graph_xla_fused"], 2),
+                },
+                "dep_graph_impl_winner": (
+                    "pallas"
+                    if na_ab_ms["fused_narrow_default"]
+                    <= na_ab_ms["dep_graph_xla_fused"]
+                    else "xla"
+                ),
                 # Continuous-batching engine headline (r07): offline
                 # throughput on mixed prompts/budgets, decode waste on each
                 # path, and Poisson-arrival request latency. The ratio
@@ -1123,6 +1221,24 @@ def main():
                 ),
                 "engine_p50_latency_ms": round(engine_p50, 1),
                 "engine_p95_latency_ms": round(engine_p95, 1),
+                # r09 lever 2: fused sampling tail (filter+gumbel+argmax+
+                # active-merge in one scope, Pallas on chip) vs the r07
+                # multi-op tail — identical requests, bit-identical outputs,
+                # the lower wall names the production default.
+                "sampling_fused_ab_ms": sampling_fused_ab_ms,
+                "sampling_impl_winner": min(
+                    sampling_fused_ab_ms, key=sampling_fused_ab_ms.get
+                ),
+                # r09 lever 3: int8 KV-cache decode. Throughput is the
+                # bandwidth half of the verdict; kvq_slots_per_chip_ratio
+                # (max admissible slots vs the bf16 cache at a 16 GB HBM
+                # budget, allocation-free accounting) is the capacity half
+                # that caps production batch size.
+                "kvq_engine_events_per_sec_per_chip": round(kvq_rate, 1),
+                "kvq_vs_float_engine_ratio": round(
+                    kvq_rate / max(engine_rate, 1e-9), 3
+                ),
+                "kvq_slots_per_chip_ratio": kvq_slots_ratio,
                 # Online serving service headline (r08): the SAME Poisson
                 # trace through the async double-buffered service (1
                 # replica, depth-2 dispatch, budget-capped prefill, SLO

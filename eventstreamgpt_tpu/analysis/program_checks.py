@@ -40,6 +40,8 @@ __all__ = [
     "canonical_finetune_step",
     "canonical_generation_program",
     "canonical_engine_programs",
+    "canonical_kvq_engine_programs",
+    "canonical_sampling_engine_program",
     "canonical_service_programs",
     "check_no_f64",
     "check_no_host_transfers",
@@ -85,7 +87,13 @@ def _require_devices(n: int) -> None:
 
 
 # ----------------------------------------------------------- canonical steps
-def canonical_pretrain_step(n_data: int, n_model: int, with_health: bool = False, na: bool = False):
+def canonical_pretrain_step(
+    n_data: int,
+    n_model: int,
+    with_health: bool = False,
+    na: bool = False,
+    na_impl: str | None = None,
+):
     """The production pretrain train step on a ``data×model`` mesh — the
     exact construction ``dryrun_multichip`` audits into ``COLLECTIVES.json``
     (same tiny shapes, so inventories are directly comparable).
@@ -94,9 +102,13 @@ def canonical_pretrain_step(n_data: int, n_model: int, with_health: bool = False
     which is what ``train()`` jits by default since the reliability
     subsystem landed (sentinel_enabled defaults to true). ``na`` builds the
     NestedAttention flagship (fused dep-graph attention + narrow head
-    projections — the r06 NA production defaults). CI programs compile
-    under ``gradient_checkpointing="save_attention"`` (the r06
-    production-width remat policy), matching the dry run."""
+    projections — the r06 NA production defaults); ``na_impl`` pins the
+    dep-graph attention implementation (``"pallas_interpret"`` builds the
+    r09 Pallas-kernel program in interpreter mode, which lowers and
+    compiles on the virtual CPU mesh — the TPU production program differs
+    only in the kernel's Mosaic body). CI programs compile under
+    ``gradient_checkpointing="save_attention"`` (the r06 production-width
+    remat policy), matching the dry run."""
     import jax
     import jax.numpy as jnp
 
@@ -108,7 +120,8 @@ def canonical_pretrain_step(n_data: int, n_model: int, with_health: bool = False
     _require_devices(n_data * n_model)
     mesh = make_mesh(n_data, n_model)
     if na:
-        model, batch = ge._make_model_and_batch(batch_size=2 * n_data, na=True)
+        overrides = {"dep_graph_attention_impl": na_impl} if na_impl else {}
+        model, batch = ge._make_model_and_batch(batch_size=2 * n_data, na=True, **overrides)
     else:
         model, batch = ge._make_model_and_batch(
             batch_size=2 * n_data, gradient_checkpointing="save_attention"
@@ -224,6 +237,73 @@ def canonical_engine_programs(n_data: int = 8) -> dict:
         mesh=mesh,
     )
     return engine.aot_programs(bucket_len=8, group=2)
+
+
+def canonical_kvq_engine_programs(n_data: int = 8) -> dict:
+    """The r09 quantized-decode engine programs on the dp8 mesh: int8 KV
+    caches — quantize-on-write at the per-row cursor, dequantize-on-read in
+    the attention contraction, quantize-on-admission in prefill's admit
+    scatter — through the same f64-free / host-transfer-free /
+    collective-budget gates as the float engine. The ``engine_kvq_dp8``
+    budget pins the contract that quantization adds (near-)zero
+    communication: scales live beside the planes and every new op is
+    slot-local. Sampling rides the fused tail on its mesh-auto impl (XLA
+    on multi-device meshes — the kernel grid would otherwise all-gather
+    the slot-sharded logits plane; see `GenerationEngine`); the Pallas
+    sampling kernel itself is gated by
+    `canonical_sampling_engine_program`."""
+    import jax
+
+    from ..serving import GenerationEngine
+    from ..training.sharding import make_mesh
+
+    ge = _graft_entry()
+    _require_devices(n_data)
+    mesh = make_mesh(n_data, 1)
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=2 * n_data,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        mesh=mesh,
+        kv_cache_dtype="int8",
+    )
+    return engine.aot_programs(bucket_len=8, group=2)
+
+
+def canonical_sampling_engine_program() -> dict:
+    """The fused-sampling decode program, unsharded (one device, the
+    single-replica serving topology the kernel targets): int8 cache +
+    the Pallas sampling kernel in interpreter mode. Gated f64-free and
+    host-transfer-free — the kernel's masked-fill/gumbel/argmax epilogue
+    must not smuggle callbacks into the decode hot loop — and against a
+    zero-collective budget (single device ⇒ any collective is a bug)."""
+    import jax
+
+    from ..serving import GenerationEngine
+
+    ge = _graft_entry()
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=4,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        kv_cache_dtype="int8",
+        sampling_impl="pallas_interpret",
+    )
+    return {"decode": engine.aot_programs(bucket_len=8, group=2)["decode"]}
 
 
 def canonical_service_programs(n_data: int = 8) -> dict:
@@ -362,6 +442,13 @@ def run_program_checks(
     # collective budget — the fused walk must not smuggle host callbacks or
     # unbudgeted collectives into the step.
     programs["pretrain:na_dp8"] = canonical_pretrain_step(8, 1, na=True)
+    # The r09 Pallas dep-graph kernel variant (interpreter mode on the CPU
+    # mesh — same program structure as the TPU production compile modulo
+    # the Mosaic kernel body): the hand kernel's custom_vjp must not
+    # smuggle callbacks, f64, or unbudgeted collectives into the step.
+    programs["pretrain:na_pallas_dp8"] = canonical_pretrain_step(
+        8, 1, na=True, na_impl="pallas_interpret"
+    )
     programs["finetune:dp8"] = canonical_finetune_step(8)
     programs["finetune:dp8_health"] = canonical_finetune_step(8, with_health=True)
     programs["generation:ci"] = canonical_generation_program()
@@ -370,6 +457,16 @@ def run_program_checks(
     # committed collective budget below.
     for label, (fn, args) in canonical_engine_programs(8).items():
         programs[f"engine:{label}"] = (fn, args)
+    # The r09 quantized-decode engine (int8 cache, fused-XLA sampling on
+    # the sharded mesh): the decode hot loop with quantize-on-write /
+    # dequantize-on-read gates against its own committed budget.
+    for label, (fn, args) in canonical_kvq_engine_programs(8).items():
+        programs[f"engine_kvq:{label}"] = (fn, args)
+    # The Pallas fused-sampling decode program (unsharded single-replica
+    # topology): zero-collective by construction, and the kernel epilogue
+    # must stay callback-free.
+    for label, (fn, args) in canonical_sampling_engine_program().items():
+        programs[f"engine_sampling:{label}"] = (fn, args)
     # The online service's dispatch programs (2-replica service over dp8,
     # deeper decode chunk): the service hot path must stay host-transfer-
     # free beyond the one async boundary fetch — a callback smuggled into
@@ -393,8 +490,12 @@ def run_program_checks(
         budget_keys = {f"pretrain:{name}": name for name in layouts}
         budget_keys["pretrain:dp8_health"] = "dp8"
         budget_keys["pretrain:na_dp8"] = "na_dp8"
+        budget_keys["pretrain:na_pallas_dp8"] = "na_pallas_dp8"
         budget_keys["engine:decode"] = "engine_dp8"
         budget_keys["engine:prefill_b8"] = "engine_prefill_dp8"
+        budget_keys["engine_kvq:decode"] = "engine_kvq_dp8"
+        budget_keys["engine_kvq:prefill_b8"] = "engine_kvq_prefill_dp8"
+        budget_keys["engine_sampling:decode"] = "engine_sampling_1dev"
         budget_keys["service:decode"] = "service_dp8"
         budget_keys["service:prefill_b8"] = "service_prefill_dp8"
         budget_keys["service:boundary_pack"] = "service_boundary_dp8"

@@ -60,22 +60,43 @@ def _named_key(key: jax.Array, name: str) -> jax.Array:
 
 
 def sample_predictions(
-    preds: GenerativeSequenceModelPredictions, event_mask: Array, key: jax.Array
+    preds: GenerativeSequenceModelPredictions,
+    event_mask: Array,
+    key: jax.Array,
+    categorical_sampler=None,
 ) -> GenerativeSequenceModelSamples:
     """Samples an event from per-head predictions (reference ``:1093``).
 
     ``preds`` must already be sliced to the source event (trailing sequence
     dim removed). ``event_mask`` is the (B,) mask for the sampled event.
+
+    ``categorical_sampler`` optionally replaces every `Categorical` head's
+    draw: a ``(logits, key) -> int32`` callable (the serving engine passes
+    `ops.fused_sampling.fused_categorical` here — its fused filter+draw
+    tail is bit-exact vs ``Categorical.sample`` when unfiltered, so the
+    engine's ``generate()`` parity contract survives the swap). ``None``
+    keeps the reference multi-op tail.
     """
+
+    def _draw_categorical(dist: Categorical, k: jax.Array) -> Array:
+        if categorical_sampler is not None:
+            return categorical_sampler(dist.logits, k)
+        return dist.sample(k)
+
     sampled_classification = None
     if preds.classification is not None:
         sampled_classification = {}
         for k, (is_obs_dist, dist) in preds.classification.items():
             if is_obs_dist is None:
-                sampled_classification[k] = dist.sample(_named_key(key, f"cls:{k}"))
+                if isinstance(dist, Categorical):
+                    sampled_classification[k] = _draw_categorical(
+                        dist, _named_key(key, f"cls:{k}")
+                    )
+                else:
+                    sampled_classification[k] = dist.sample(_named_key(key, f"cls:{k}"))
             elif isinstance(dist, Categorical):
                 is_obs = is_obs_dist.sample(_named_key(key, f"cls_obs:{k}")) == 1
-                samp = dist.sample(_named_key(key, f"cls:{k}"))
+                samp = _draw_categorical(dist, _named_key(key, f"cls:{k}"))
                 sampled_classification[k] = jnp.where(is_obs, samp, 0)
             else:
                 raise ValueError(f"Don't know how to sample classification dist {dist}!")

@@ -207,6 +207,7 @@ class StructuredTransformerConfig(JSONableMixin):
         dep_graph_attention_types: ATTENTION_TYPES_LIST_T | None = None,
         dep_graph_window_size: int | None = 2,
         dep_graph_fused_attention: bool | None = True,
+        dep_graph_attention_impl: str | None = None,
         head_narrow_projections: bool = True,
         intermediate_size: int = 32,
         activation_function: str = "gelu",
@@ -478,6 +479,18 @@ class StructuredTransformerConfig(JSONableMixin):
         # tests (tests/models/test_dep_graph_fused.py); False restores the
         # einsum path for A/Bs (bench.py records both every run).
         self.dep_graph_fused_attention = dep_graph_fused_attention
+        # Which implementation the fused dep-graph walk runs on: None/"auto"
+        # resolves per backend (the hand-tiled Pallas kernel on TPU, the
+        # fused-XLA formulation elsewhere; $ESGPT_PALLAS_IMPL overrides —
+        # ops/impl_select.py). Explicit "pallas" / "pallas_interpret" / "xla"
+        # pin it — the bench A/B (`dep_graph_pallas_ab_ms`) drives both arms
+        # through this knob.
+        if dep_graph_attention_impl not in (None, "auto", "pallas", "pallas_interpret", "xla"):
+            raise ValueError(
+                "dep_graph_attention_impl must be None/'auto'/'pallas'/"
+                f"'pallas_interpret'/'xla'; got {dep_graph_attention_impl}"
+            )
+        self.dep_graph_attention_impl = dep_graph_attention_impl
         # Output-head classification projections: when a call needs only a
         # narrow vocabulary span (the NA per-level walk), project just those
         # columns of the ClassificationLayer kernel instead of the full

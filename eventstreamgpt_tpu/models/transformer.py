@@ -63,34 +63,70 @@ class KVCache:
     is the number of positions already written — a scalar int32 on the
     cohort generation path, or a per-row ``(B,)`` vector on the serving
     engine's slot-decode path (each slot advances its own cursor).
+
+    Quantized decode caches (``key.dtype`` int8/fp8 — the serving engine's
+    ``kv_cache_dtype`` lever, `ops.kv_quant`) additionally carry
+    ``key_scale``/``value_scale``: per-head-per-row fp32 scale tables of
+    shape ``(B, H, max_len)``, written alongside the quantized planes at
+    the cursor and consumed by the dequantize-on-read multiply fused into
+    the attention contraction. ``None`` on float caches — the pytree then
+    has exactly its historical leaves, so checkpoints and donation
+    signatures are unchanged.
     """
 
     key: Array
     value: Array
     mask: Array
-    length: Array  # scalar int32
+    length: Array  # scalar int32 or per-row (B,) int32
+    key_scale: Optional[Array] = None  # (B, H, max_len) fp32 when quantized
+    value_scale: Optional[Array] = None
 
     @classmethod
     def init(cls, batch_size: int, num_heads: int, max_len: int, head_dim: int, dtype=jnp.float32):
+        from ..ops.kv_quant import is_quantized_dtype
+
+        quantized = is_quantized_dtype(dtype)
+
+        def scale():
+            # Distinct buffers per field: donation rejects aliased arguments.
+            return (
+                jnp.ones((batch_size, num_heads, max_len), jnp.float32)
+                if quantized
+                else None
+            )
+
         return cls(
             key=jnp.zeros((batch_size, num_heads, max_len, head_dim), dtype=dtype),
             value=jnp.zeros((batch_size, num_heads, max_len, head_dim), dtype=dtype),
             mask=jnp.zeros((batch_size, max_len), dtype=bool),
             length=jnp.zeros((), dtype=jnp.int32),
+            key_scale=scale(),
+            value_scale=scale(),
         )
 
 
 def init_kv_caches(
-    config: StructuredTransformerConfig, batch_size: int, max_len: int | None = None, dtype=None
+    config: StructuredTransformerConfig,
+    batch_size: int,
+    max_len: int | None = None,
+    dtype=None,
+    cache_dtype: str | None = None,
 ) -> tuple[KVCache, ...]:
     """Preallocates one `KVCache` per hidden layer.
 
     Cache buffers default to the model's compute dtype so bf16 keys/values
     written by ``lax.dynamic_update_slice`` match the buffer dtype.
+    ``cache_dtype`` names a storage type instead (``"bf16"``/``"fp32"``/
+    ``"int8"``/``"fp8"`` — `ops.kv_quant.resolve_cache_dtype`); quantized
+    names allocate the per-head-per-row scale tables alongside.
     """
     if max_len is None:
         max_len = config.max_seq_len
-    if dtype is None:
+    if cache_dtype is not None:
+        from ..ops.kv_quant import resolve_cache_dtype
+
+        dtype, _ = resolve_cache_dtype(cache_dtype, config.compute_dtype)
+    elif dtype is None:
         dtype = config.compute_dtype
     return tuple(
         KVCache.init(batch_size, config.num_attention_heads, max_len, config.head_dim, dtype)
@@ -271,16 +307,55 @@ class InnerSelfAttention(nn.Module):
             pos = jnp.arange(max_len)
             write = pos[None, :] == start[:, None]  # (B, max_len)
             # key/value are (B, H, 1, D): broadcast over the buffer axis and
-            # write exactly each row's cursor position.
-            new_key = jnp.where(write[:, None, :, None], key, layer_past.key)
-            new_value = jnp.where(write[:, None, :, None], value, layer_past.value)
+            # write exactly each row's cursor position. The explicit astype
+            # pins the buffer dtype: jnp.where would otherwise silently
+            # promote a narrower cache (bf16 buffers under fp32 compute) to
+            # the chunk dtype — the regression `TestKVCacheDtypePreservation`
+            # guards. Quantized caches (int8/fp8 + scale tables) instead
+            # quantize-on-write here — the per-row cursor scatter — and the
+            # scale tables ride the same one-hot select.
+            quantized = layer_past.key_scale is not None
+            if quantized:
+                from ..ops.kv_quant import dequantize_kv, quantize_kv
+
+                k_q, k_s = quantize_kv(key, layer_past.key.dtype)
+                v_q, v_s = quantize_kv(value, layer_past.value.dtype)
+                new_key = jnp.where(write[:, None, :, None], k_q, layer_past.key)
+                new_value = jnp.where(write[:, None, :, None], v_q, layer_past.value)
+                new_key_scale = jnp.where(write[:, None, :], k_s, layer_past.key_scale)
+                new_value_scale = jnp.where(
+                    write[:, None, :], v_s, layer_past.value_scale
+                )
+            else:
+                new_key = jnp.where(
+                    write[:, None, :, None], key.astype(layer_past.key.dtype), layer_past.key
+                )
+                new_value = jnp.where(
+                    write[:, None, :, None],
+                    value.astype(layer_past.value.dtype),
+                    layer_past.value,
+                )
+                new_key_scale = new_value_scale = None
             chunk_mask = (
                 attention_mask if attention_mask is not None else jnp.ones((B, S), dtype=bool)
             )
             new_mask = jnp.where(write, chunk_mask, layer_past.mask)
             if use_cache:
-                present = KVCache(key=new_key, value=new_value, mask=new_mask, length=start + S)
-            key, value = new_key, new_value
+                present = KVCache(
+                    key=new_key,
+                    value=new_value,
+                    mask=new_mask,
+                    length=start + S,
+                    key_scale=new_key_scale,
+                    value_scale=new_value_scale,
+                )
+            if quantized:
+                # Dequantize-on-read: the multiply sits directly before the
+                # QK^T / PV contractions and fuses into their operand scope.
+                key = dequantize_kv(new_key, new_key_scale, dt)
+                value = dequantize_kv(new_value, new_value_scale, dt)
+            else:
+                key, value = new_key, new_value
             k_positions = pos
             q_positions = start[:, None] + jnp.arange(q_len)[None, :] + (
                 1 if static_kv_first else 0
@@ -293,15 +368,53 @@ class InnerSelfAttention(nn.Module):
             # buffer with validity masking.
             max_len = layer_past.key.shape[2]
             start = layer_past.length
-            new_key = jax.lax.dynamic_update_slice(layer_past.key, key, (0, 0, start, 0))
-            new_value = jax.lax.dynamic_update_slice(layer_past.value, value, (0, 0, start, 0))
+            # Same dtype/quantization contract as the vector branch: explicit
+            # astype pins narrower float buffers; quantized caches quantize
+            # the chunk on write (scale tables updated at the same cursor)
+            # and dequantize the full buffer on read, fused into the
+            # attention contraction.
+            quantized = layer_past.key_scale is not None
+            if quantized:
+                from ..ops.kv_quant import dequantize_kv, quantize_kv
+
+                k_q, k_s = quantize_kv(key, layer_past.key.dtype)
+                v_q, v_s = quantize_kv(value, layer_past.value.dtype)
+                new_key = jax.lax.dynamic_update_slice(layer_past.key, k_q, (0, 0, start, 0))
+                new_value = jax.lax.dynamic_update_slice(
+                    layer_past.value, v_q, (0, 0, start, 0)
+                )
+                new_key_scale = jax.lax.dynamic_update_slice(
+                    layer_past.key_scale, k_s, (0, 0, start)
+                )
+                new_value_scale = jax.lax.dynamic_update_slice(
+                    layer_past.value_scale, v_s, (0, 0, start)
+                )
+            else:
+                new_key = jax.lax.dynamic_update_slice(
+                    layer_past.key, key.astype(layer_past.key.dtype), (0, 0, start, 0)
+                )
+                new_value = jax.lax.dynamic_update_slice(
+                    layer_past.value, value.astype(layer_past.value.dtype), (0, 0, start, 0)
+                )
+                new_key_scale = new_value_scale = None
             chunk_mask = (
                 attention_mask if attention_mask is not None else jnp.ones((B, S), dtype=bool)
             )
             new_mask = jax.lax.dynamic_update_slice(layer_past.mask, chunk_mask, (0, start))
             if use_cache:
-                present = KVCache(key=new_key, value=new_value, mask=new_mask, length=start + S)
-            key, value = new_key, new_value
+                present = KVCache(
+                    key=new_key,
+                    value=new_value,
+                    mask=new_mask,
+                    length=start + S,
+                    key_scale=new_key_scale,
+                    value_scale=new_value_scale,
+                )
+            if quantized:
+                key = dequantize_kv(new_key, new_key_scale, dt)
+                value = dequantize_kv(new_value, new_value_scale, dt)
+            else:
+                key, value = new_key, new_value
             k_positions = jnp.arange(max_len)
             q_positions = start + jnp.arange(q_len) + (1 if static_kv_first else 0)
             valid_k = k_positions < (start + S)
@@ -415,8 +528,19 @@ class InnerSelfAttention(nn.Module):
             from ..ops.band_attention import dep_graph_attention
 
             window = self.window_size if self.attention_type == "local" else None
-            attn_dropout = nn.Dropout(rate=float(cfg.attention_dropout), name="attn_dropout")
-            deterministic = not self.has_rng("dropout")
+            # Attention dropout rides as a precomputed keep-mask so the
+            # Pallas kernel and the fused-XLA fallback apply the IDENTICAL
+            # mask (ops/pallas_dep_graph.py module docs) — the r08 parity
+            # contract extends to training-mode dropout. Semantics match
+            # nn.Dropout: keep -> p / keep_prob, drop -> 0.
+            rate = float(cfg.attention_dropout)
+            dropout_mask = None
+            if rate > 0.0 and self.has_rng("dropout"):
+                dropout_mask = jax.random.bernoulli(
+                    self.make_rng("dropout"),
+                    1.0 - rate,
+                    (query.shape[0], query.shape[1], key.shape[1], num_heads),
+                )
             # query/key/value are still (N, S, H, D) — the matmuls' natural
             # layout; the fused op contracts in place, so the dep-graph walk
             # performs no transposes at all.
@@ -426,7 +550,11 @@ class InnerSelfAttention(nn.Module):
                 value,
                 q_offset=1 if static_kv_first else 0,
                 window=window,
-                probs_transform=lambda p: attn_dropout(p, deterministic=deterministic),
+                dropout_mask=dropout_mask,
+                dropout_rate=rate,
+                # auto: the Pallas kernel on TPU, fused-XLA elsewhere;
+                # config/$ESGPT_PALLAS_IMPL override (ops/impl_select.py).
+                impl=getattr(cfg, "dep_graph_attention_impl", None),
             )
             outputs = {"present_key_value": None, "_heads_first_out": False}
         elif ring_ctx is not None:

@@ -124,6 +124,9 @@ def dep_graph_attention(
     q_offset: int = 0,
     window: int | None = None,
     probs_transform: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+    dropout_mask: jnp.ndarray | None = None,
+    dropout_rate: float = 0.0,
+    impl: str | None = None,
 ) -> jnp.ndarray:
     """Fused causal attention over tiny per-event dependency-graph rows.
 
@@ -154,13 +157,74 @@ def dep_graph_attention(
         window: optional sliding-window width over graph positions
             (``dep_graph_attention_types="local"``); ``None`` = global.
         probs_transform: optional hook applied to the ``(N, Q, S, H)``
-            fp32 attention probabilities (attention dropout).
+            fp32 attention probabilities — XLA impl only (a host-side
+            closure cannot cross into a Pallas kernel); mutually exclusive
+            with ``dropout_mask``.
+        dropout_mask: optional precomputed ``(N, Q, S, H)`` boolean keep
+            mask for attention dropout, applied identically by every impl
+            as ``where(keep, p / (1 - dropout_rate), 0)`` — drawn by the
+            caller from its dropout rng so the kernel and the XLA fallback
+            see the same mask (`pallas_dep_graph` module docs).
+        dropout_rate: the dropout rate the mask was drawn at.
+        impl: ``None``/"auto" (the Pallas kernel on TPU, the fused-XLA
+            formulation elsewhere; ``$ESGPT_PALLAS_IMPL`` overrides —
+            `ops.impl_select`), ``"pallas"``, ``"pallas_interpret"``, or
+            ``"xla"``.
 
     Returns:
         ``(N, Q, H, D)`` attention outputs in ``value``'s dtype. Logits are
         NOT scaled by ``1/sqrt(D)`` (GPT-Neo lineage) and softmax runs in
         fp32, exactly like the einsum path in ``models/transformer.py``.
+        Parity contract: the Pallas kernel is bit-exact vs the XLA impl in
+        fp32 (fwd and bwd) and exact to the same value-dtype roundings in
+        bf16 (``tests/test_pallas_dep_graph.py``).
     """
+    from .impl_select import resolve_impl
+
+    explicit_kernel = impl in ("pallas", "pallas_interpret")
+    impl = resolve_impl(impl, "dep_graph_attention")
+    if probs_transform is not None and dropout_mask is not None:
+        raise ValueError("pass either probs_transform or dropout_mask, not both")
+    if probs_transform is not None and impl in ("pallas", "pallas_interpret"):
+        # A host-side closure cannot cross into the kernel. Auto (and env)
+        # resolution degrades to the XLA formulation, which supports it;
+        # only an explicitly requested kernel impl is an error.
+        if not explicit_kernel:
+            impl = "xla"
+        else:
+            raise ValueError(
+                "the Pallas dep-graph kernel takes dropout as a precomputed "
+                "dropout_mask, not a probs_transform closure"
+            )
+    if impl in ("pallas", "pallas_interpret"):
+        from .pallas_dep_graph import dep_graph_attention_pallas
+
+        return dep_graph_attention_pallas(
+            query,
+            key,
+            value,
+            q_offset=q_offset,
+            window=window,
+            dropout_mask=dropout_mask,
+            dropout_rate=dropout_rate,
+            interpret=impl == "pallas_interpret",
+        )
+    return _dep_graph_attention_xla(
+        query,
+        key,
+        value,
+        q_offset=q_offset,
+        window=window,
+        probs_transform=probs_transform,
+        dropout_mask=dropout_mask,
+        dropout_rate=dropout_rate,
+    )
+
+
+def _dep_graph_attention_xla(
+    query, key, value, q_offset, window, probs_transform, dropout_mask, dropout_rate
+):
+    """The fused-XLA formulation (the r06 lever) — also the parity reference."""
     N, Q, H, D = query.shape
     S = key.shape[1]
     q_pos = jnp.arange(Q) + q_offset
@@ -178,6 +242,10 @@ def dep_graph_attention(
     probs = jax.nn.softmax(logits, axis=2)
     if probs_transform is not None:
         probs = probs_transform(probs)
+    if dropout_mask is not None:
+        # Identical semantics to nn.Dropout (and to the kernel impl):
+        # keep -> p / keep_prob, drop -> 0.
+        probs = jnp.where(dropout_mask, probs / (1.0 - float(dropout_rate)), 0.0)
     # Match the einsum path's probs dtype drop before the PV contraction,
     # then accumulate in fp32.
     pv = probs.astype(value.dtype).astype(jnp.float32)[..., None] * value.astype(

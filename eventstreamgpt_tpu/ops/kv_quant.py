@@ -1,0 +1,159 @@
+"""Quantized KV-cache storage for decode: int8 / fp8 planes + scale tables.
+
+Serving capacity is HBM-bound: slots-per-chip is capped by the per-slot KV
+cache (``n_layers · 2 · H · max_len · head_dim`` elements), and decode step
+time is memory-bandwidth-bound on reading it back every event. Storing the
+cache at 1 byte/element (int8, or fp8 where the jaxlib carries
+``float8_e4m3fn``) is therefore simultaneously a **capacity** lever (2x
+slots vs bf16, 4x vs fp32 — minus the scale tables) and a **bandwidth**
+lever (LightSeq / the Gemma-on-TPU serving comparison, PAPERS.md).
+
+Scheme: symmetric absmax quantization with **per-head-per-row** fp32
+scales — one scale per ``(row, head, cache position)``, reduced over the
+``head_dim`` lane axis only. K and V rows are written once (at the decode
+cursor / at admission) and read every subsequent step, so quantize-on-write
+is the cheap side; the dequantize multiply on read sits next to the
+attention contraction and fuses into its operand scope (no dequantized
+copy of the cache ever materializes in HBM).
+
+Numerics contract (docs/serving.md "Quantized decode cache"): int8 absmax
+per 64-lane rows carries ~0.4% relative error per element; generated
+event *structure and integer content* reproduce the float cache exactly in
+the parity suites (``tests/test_kv_quant.py`` — sampled trajectories are
+argmax/gumbel draws, robust to sub-percent logit perturbation at fixed
+seeds), while float content (times, values) is pinned to a documented
+tolerance. Training, prefill-internal attention, and the cohort
+``generate()`` path are untouched — quantization lives only in the cache
+buffers the decode loop persists.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+__all__ = [
+    "FP8_DTYPE",
+    "HAS_FP8",
+    "CACHE_DTYPES",
+    "resolve_cache_dtype",
+    "is_quantized_dtype",
+    "cache_dtype_name",
+    "quantize_kv",
+    "dequantize_kv",
+    "kv_cache_bytes_per_slot",
+]
+
+Array = Any
+
+# fp8 support is jaxlib-gated; int8 is universal.
+FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+HAS_FP8 = FP8_DTYPE is not None
+_FP8_MAX = 448.0  # e4m3fn finite max
+_INT8_MAX = 127.0
+
+CACHE_DTYPES = ("fp32", "bf16", "int8") + (("fp8",) if HAS_FP8 else ())
+
+
+def resolve_cache_dtype(name: str | None, compute_dtype) -> tuple[Any, bool]:
+    """``(buffer dtype, quantized?)`` for a cache-dtype name.
+
+    ``None``/"auto" keeps the model compute dtype (the parity-exact
+    default). ``"fp8"`` raises on jaxlibs without ``float8_e4m3fn`` —
+    callers gate on `HAS_FP8`.
+    """
+    if name in (None, "auto"):
+        return jnp.dtype(compute_dtype), False
+    if name in ("fp32", "f32", "float32"):
+        return jnp.dtype(jnp.float32), False
+    if name in ("bf16", "bfloat16"):
+        return jnp.dtype(jnp.bfloat16), False
+    if name == "int8":
+        return jnp.dtype(jnp.int8), True
+    if name == "fp8":
+        if not HAS_FP8:
+            raise ValueError(
+                "kv_cache_dtype='fp8' needs a jaxlib with float8_e4m3fn; "
+                f"this one has none (use {CACHE_DTYPES})"
+            )
+        return jnp.dtype(FP8_DTYPE), True
+    raise ValueError(f"unknown kv_cache_dtype {name!r}; expected one of {CACHE_DTYPES}")
+
+
+def is_quantized_dtype(dtype) -> bool:
+    dtype = jnp.dtype(dtype)
+    return dtype == jnp.int8 or (HAS_FP8 and dtype == jnp.dtype(FP8_DTYPE))
+
+
+def cache_dtype_name(dtype) -> str:
+    """The canonical `CACHE_DTYPES` name for a resolved buffer dtype —
+    accepted aliases ("bfloat16", "f32", ...) and ``None`` all funnel
+    through `resolve_cache_dtype` to a dtype, and this maps it back to the
+    one name reports/keys use."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.int8:
+        return "int8"
+    if HAS_FP8 and dtype == jnp.dtype(FP8_DTYPE):
+        return "fp8"
+    if dtype == jnp.bfloat16:
+        return "bf16"
+    if dtype == jnp.float32:
+        return "fp32"
+    raise ValueError(f"no canonical cache-dtype name for {dtype}")
+
+
+def _qmax(dtype) -> float:
+    return _INT8_MAX if jnp.dtype(dtype) == jnp.int8 else _FP8_MAX
+
+
+def quantize_kv(x: Array, dtype) -> tuple[Array, Array]:
+    """Symmetric absmax quantization over the last (head_dim) axis.
+
+    Args:
+        x: float K or V values ``(..., D)``.
+        dtype: ``int8`` or the fp8 dtype.
+
+    Returns:
+        ``(q, scale)`` — ``q`` in ``dtype`` with ``x ≈ q · scale[..., None]``,
+        ``scale`` fp32 ``(...,)`` (one per head-row; 1.0 for all-zero rows
+        so dequantization never divides by zero).
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / _qmax(dtype), 1.0)
+    scaled = xf / scale[..., None]
+    if jnp.dtype(dtype) == jnp.int8:
+        q = jnp.clip(jnp.round(scaled), -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    else:
+        q = scaled.astype(dtype)
+    return q, scale
+
+
+def dequantize_kv(q: Array, scale: Array, dtype) -> Array:
+    """``q · scale[..., None]`` in ``dtype`` — placed directly before the
+    attention contraction so XLA fuses the convert+multiply into the dot's
+    operand scope (the cache is never re-materialized in float)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def kv_cache_bytes_per_slot(
+    num_layers: int,
+    num_heads: int,
+    max_len: int,
+    head_dim: int,
+    cache_dtype: str | None,
+    compute_dtype=jnp.float32,
+) -> int:
+    """HBM bytes of seq KV cache per decode slot at a given cache dtype.
+
+    Counts the K+V planes plus, for quantized dtypes, the per-head-per-row
+    fp32 scale tables and the shared ``(max_len,)`` mask byte — the
+    serving `slots_report` uses this to derive max admissible slots per
+    dtype without allocating anything.
+    """
+    dtype, quantized = resolve_cache_dtype(cache_dtype, compute_dtype)
+    plane = num_heads * max_len * head_dim * jnp.dtype(dtype).itemsize
+    scales = num_heads * max_len * 4 if quantized else 0
+    mask = max_len  # bool
+    return num_layers * (2 * plane + 2 * scales + mask)

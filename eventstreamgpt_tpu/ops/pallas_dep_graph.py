@@ -1,0 +1,419 @@
+"""Pallas TPU kernel for the NA per-event dependency-graph attention walk.
+
+The fused-XLA formulation (`ops.band_attention._dep_graph_attention_xla`,
+the r06 lever) already removed the dot_general relayout friction from the
+``(B·L, G+1)`` walk, but XLA still schedules it as a handful of fusion
+scopes with HBM round-trips between the logits, softmax, and PV stages.
+This kernel is the deferred hand-tiled swing (BASELINE r06 "deliberately
+deferred"): one grid pass over row tiles, with the causal/window mask, the
+fp32 softmax, attention dropout, and both contractions resident in VMEM —
+each Q/K/V element is read from HBM exactly once per direction.
+
+Geometry: the graph depth ``S = G+1`` and query count ``Q`` are tiny
+static constants (4 and 3 at the bench shape), so the kernel unrolls them
+as Python loops and every in-flight tensor is a 2D/3D ``(row_tile, H[, D])``
+block — VPU-native shapes with no 5D intermediates for Mosaic to relayout
+(the exact failure mode that made the dot_general formulation slow).
+
+Numerics mirror the XLA formulation op for op (upcast-then-multiply
+logits, fp32 softmax, probs dropped to the value dtype before the fp32 PV
+accumulation), so the fp32 parity contract vs `dep_graph_attention` is
+**bit-exact** and bf16 is exact to the same roundings — pinned by
+``tests/test_pallas_dep_graph.py``. The backward is a second hand kernel
+(`pallas_heads` custom_vjp precedent) recomputing the softmax from the
+saved q/k/v residuals (S is tiny — recompute is cheaper than an
+``(N, Q, S, H)`` probs round-trip through HBM) and emitting dq/dk/dv in
+one pass, matching XLA's autodiff of the reference formulation.
+
+Dropout rides as a precomputed keep-mask (+ static rate): the mask is
+drawn OUTSIDE the kernel from the module's dropout rng (threefry stays an
+XLA op), and both impls apply the identical ``where(keep, p/keep_prob, 0)``
+— so kernel-vs-XLA parity holds under dropout too, which a kernel-internal
+PRNG could never guarantee.
+
+``interpret=True`` (``impl="pallas_interpret"``) runs the same kernel code
+on any backend — CPU CI exercises the kernel in tier-1 under the
+``pallas`` marker; ``impl`` resolution is shared package-wide
+(`ops.impl_select`, ``$ESGPT_PALLAS_IMPL``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .impl_select import compiler_params_cls
+from .impl_select import round_up as _round_up
+
+_CompilerParams = compiler_params_cls()
+
+__all__ = ["dep_graph_attention_pallas"]
+
+_ROW_TILE = 256  # rows (flattened events) per grid step; N pads up to it.
+
+
+def _mask_val(qi: int, s: int, q_offset: int, window: int | None) -> bool:
+    """The static causal/window mask bit for query qi vs graph position s."""
+    q_pos = qi + q_offset
+    ok = s <= q_pos
+    if window is not None:
+        ok = ok and s > q_pos - window
+    return ok
+
+
+def _fwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    drop_ref,
+    out_ref,
+    *,
+    Q,
+    S,
+    H,
+    D,
+    q_offset,
+    window,
+    keep_prob,
+    has_drop,
+):
+    """One row tile: logits -> masked fp32 softmax -> dropout -> PV.
+
+    Block shapes: q (tl, Q*H*D), k/v (tl, S*H*D), drop (tl, Q*S*H) int8
+    keep mask (or a (tl, 1) dummy when dropout is off — ``has_drop`` is a
+    STATIC flag, not a shape inference: a degenerate Q*S*H == 1 mask must
+    not be mistaken for the dummy), out (tl, Q*H*D). The trailing dims are
+    pre-flattened so every HBM block is 2D; the reshapes below split them
+    back inside VMEM (pallas_heads precedent).
+    """
+    tl = q_ref.shape[0]
+    q = q_ref[...].reshape(tl, Q, H, D)
+    k = k_ref[...].reshape(tl, S, H, D)
+    v = v_ref[...].reshape(tl, S, H, D)
+    v_dtype = v.dtype
+    drop = drop_ref[...].reshape(tl, Q, S, H) if has_drop else None
+
+    for qi in range(Q):
+        qf = q[:, qi].astype(jnp.float32)  # (tl, H, D)
+        # Unrolled masked logits over the S graph positions (fp32, matching
+        # the XLA path's upcast-then-multiply — exact for bf16 inputs).
+        logits = []
+        for s in range(S):
+            if _mask_val(qi, s, q_offset, window):
+                logits.append((qf * k[:, s].astype(jnp.float32)).sum(axis=-1))
+            else:
+                logits.append(None)  # statically masked: -inf
+        # fp32 softmax over the unmasked set. jax.nn.softmax subtracts the
+        # masked max; with -inf entries exp(-inf - m) == 0 exactly, so
+        # skipping masked terms reproduces it bit for bit.
+        m = None
+        for lg in logits:
+            if lg is not None:
+                m = lg if m is None else jnp.maximum(m, lg)
+        exps = [None if lg is None else jnp.exp(lg - m) for lg in logits]
+        denom = None
+        for e in exps:
+            if e is not None:
+                denom = e if denom is None else denom + e
+        acc = jnp.zeros((tl, H, D), jnp.float32)
+        for s, e in enumerate(exps):
+            if e is None:
+                continue
+            p = e / denom  # (tl, H) fp32
+            if drop is not None:
+                p = jnp.where(drop[:, qi, s] != 0, p / keep_prob, 0.0)
+            # Match the XLA path's probs dtype drop before the fp32 PV
+            # accumulation (bf16 round-trip under bf16 values).
+            p = p.astype(v_dtype).astype(jnp.float32)
+            acc = acc + p[..., None] * v[:, s].astype(jnp.float32)
+        out_ref[:, qi * H * D : (qi + 1) * H * D] = acc.astype(v_dtype).reshape(
+            tl, H * D
+        )
+
+
+def _bwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    drop_ref,
+    g_ref,
+    dq_ref,
+    dk_ref,
+    dv_ref,
+    *,
+    Q,
+    S,
+    H,
+    D,
+    q_offset,
+    window,
+    keep_prob,
+    has_drop,
+):
+    """Backward in one pass: recompute the tiny softmax, emit dq/dk/dv.
+
+    Mirrors XLA's autodiff of the reference formulation: all intermediate
+    cotangents accumulate in fp32; the probs' value-dtype round-trip in the
+    forward re-enters the chain as a cast (its derivative is the identity
+    convert, exactly as XLA differentiates ``astype``).
+    """
+    tl = q_ref.shape[0]
+    q = q_ref[...].reshape(tl, Q, H, D)
+    k = k_ref[...].reshape(tl, S, H, D)
+    v = v_ref[...].reshape(tl, S, H, D)
+    g = g_ref[...].reshape(tl, Q, H, D)
+    v_dtype = v.dtype
+    drop = drop_ref[...].reshape(tl, Q, S, H) if has_drop else None
+
+    dk_acc = [jnp.zeros((tl, H, D), jnp.float32) for _ in range(S)]
+    dv_acc = [jnp.zeros((tl, H, D), jnp.float32) for _ in range(S)]
+    for qi in range(Q):
+        qf = q[:, qi].astype(jnp.float32)
+        gf = g[:, qi].astype(jnp.float32)  # (tl, H, D) cotangent
+        logits = []
+        for s in range(S):
+            if _mask_val(qi, s, q_offset, window):
+                logits.append((qf * k[:, s].astype(jnp.float32)).sum(axis=-1))
+            else:
+                logits.append(None)
+        m = None
+        for lg in logits:
+            if lg is not None:
+                m = lg if m is None else jnp.maximum(m, lg)
+        exps = [None if lg is None else jnp.exp(lg - m) for lg in logits]
+        denom = None
+        for e in exps:
+            if e is not None:
+                denom = e if denom is None else denom + e
+        probs = [None if e is None else e / denom for e in exps]  # pre-dropout
+
+        # dP (post-dropout, post-cast) = <g, v_s>; chain back through the
+        # value-dtype cast (identity-convert) and the dropout select.
+        dp = [None] * S
+        for s, p in enumerate(probs):
+            if p is None:
+                continue
+            pd = p
+            if drop is not None:
+                pd = jnp.where(drop[:, qi, s] != 0, pd / keep_prob, 0.0)
+            pd_cast = pd.astype(v_dtype).astype(jnp.float32)
+            dv_acc[s] = dv_acc[s] + pd_cast[..., None] * gf
+            dps = (gf * v[:, s].astype(jnp.float32)).sum(axis=-1)  # (tl, H)
+            if drop is not None:
+                dps = jnp.where(drop[:, qi, s] != 0, dps / keep_prob, 0.0)
+            dp[s] = dps
+        # Softmax backward on the pre-dropout probs:
+        # dL_s = P_s * (dP_s - sum_t P_t dP_t).
+        inner = None
+        for s, p in enumerate(probs):
+            if p is None:
+                continue
+            term = p * dp[s]
+            inner = term if inner is None else inner + term
+        dq_acc = jnp.zeros((tl, H, D), jnp.float32)
+        for s, p in enumerate(probs):
+            if p is None:
+                continue
+            dl = p * (dp[s] - inner)  # (tl, H) fp32
+            dq_acc = dq_acc + dl[..., None] * k[:, s].astype(jnp.float32)
+            dk_acc[s] = dk_acc[s] + dl[..., None] * qf
+        dq_ref[:, qi * H * D : (qi + 1) * H * D] = dq_acc.astype(
+            dq_ref.dtype
+        ).reshape(tl, H * D)
+    for s in range(S):
+        dk_ref[:, s * H * D : (s + 1) * H * D] = dk_acc[s].astype(dk_ref.dtype).reshape(
+            tl, H * D
+        )
+        dv_ref[:, s * H * D : (s + 1) * H * D] = dv_acc[s].astype(dv_ref.dtype).reshape(
+            tl, H * D
+        )
+
+
+def _flatten_rows(x, N):
+    return x.reshape(N, -1)
+
+
+def _pad_rows(x, rows):
+    n = x.shape[0]
+    if rows == n:  # graftcheck: allow GC004 -- `rows` is a static Python int (shape rounded up to the row tile), not a traced value
+        return x
+    return jnp.pad(x, ((0, rows - n), (0, 0)))
+
+
+def _drop_operand(dropout_mask, N, rows):
+    """The dropout keep-mask as an int8 block operand, or a (rows, 1) dummy.
+
+    Block shapes are static per compiled kernel, so "dropout off" rides a
+    1-lane dummy rather than a second pallas_call variant.
+    """
+    if dropout_mask is None:
+        return jnp.zeros((rows, 1), jnp.int8)
+    return _pad_rows(_flatten_rows(dropout_mask.astype(jnp.int8), N), rows)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("q_offset", "window", "keep_prob", "has_drop", "interpret", "shapes"),
+)
+def _fwd_call(q2, k2, v2, drop2, *, q_offset, window, keep_prob, has_drop, interpret, shapes):
+    (Q, S, H, D) = shapes
+    rows = q2.shape[0]
+    grid = (rows // _ROW_TILE,)
+    kern = functools.partial(
+        _fwd_kernel,
+        Q=Q,
+        S=S,
+        H=H,
+        D=D,
+        q_offset=q_offset,
+        window=window,
+        keep_prob=keep_prob,
+        has_drop=has_drop,
+    )
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, q2.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, k2.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, v2.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, drop2.shape[1]), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((_ROW_TILE, q2.shape[1]), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, Q * H * D), v2.dtype),
+        compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(q2, k2, v2, drop2)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("q_offset", "window", "keep_prob", "has_drop", "interpret", "shapes"),
+)
+def _bwd_call(q2, k2, v2, drop2, g2, *, q_offset, window, keep_prob, has_drop, interpret, shapes):
+    (Q, S, H, D) = shapes
+    rows = q2.shape[0]
+    grid = (rows // _ROW_TILE,)
+    kern = functools.partial(
+        _bwd_kernel,
+        Q=Q,
+        S=S,
+        H=H,
+        D=D,
+        q_offset=q_offset,
+        window=window,
+        keep_prob=keep_prob,
+        has_drop=has_drop,
+    )
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_ROW_TILE, q2.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, k2.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, v2.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, drop2.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, g2.shape[1]), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_ROW_TILE, q2.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, k2.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_TILE, v2.shape[1]), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, Q * H * D), q2.dtype),
+            jax.ShapeDtypeStruct((rows, S * H * D), k2.dtype),
+            jax.ShapeDtypeStruct((rows, S * H * D), v2.dtype),
+        ],
+        compiler_params=_CompilerParams(dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(q2, k2, v2, drop2, g2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _dep_graph_pallas(query, key, value, dropout_mask, q_offset, window, keep_prob, interpret):
+    N, Q, H, D = query.shape
+    S = key.shape[1]
+    rows = _round_up(max(N, 1), _ROW_TILE)
+    out = _fwd_call(
+        _pad_rows(_flatten_rows(query, N), rows),
+        _pad_rows(_flatten_rows(key, N), rows),
+        _pad_rows(_flatten_rows(value, N), rows),
+        _drop_operand(dropout_mask, N, rows),
+        q_offset=q_offset,
+        window=window,
+        keep_prob=keep_prob,
+        has_drop=dropout_mask is not None,
+        interpret=interpret,
+        shapes=(Q, S, H, D),
+    )
+    return out[:N].reshape(N, Q, H, D)
+
+
+def _dep_graph_pallas_fwd(query, key, value, dropout_mask, q_offset, window, keep_prob, interpret):
+    out = _dep_graph_pallas(
+        query, key, value, dropout_mask, q_offset, window, keep_prob, interpret
+    )
+    return out, (query, key, value, dropout_mask)
+
+
+def _dep_graph_pallas_bwd(q_offset, window, keep_prob, interpret, res, g):
+    query, key, value, dropout_mask = res
+    N, Q, H, D = query.shape
+    S = key.shape[1]
+    rows = _round_up(max(N, 1), _ROW_TILE)
+    dq, dk, dv = _bwd_call(
+        _pad_rows(_flatten_rows(query, N), rows),
+        _pad_rows(_flatten_rows(key, N), rows),
+        _pad_rows(_flatten_rows(value, N), rows),
+        _drop_operand(dropout_mask, N, rows),
+        _pad_rows(_flatten_rows(g.astype(value.dtype), N), rows),
+        q_offset=q_offset,
+        window=window,
+        keep_prob=keep_prob,
+        has_drop=dropout_mask is not None,
+        interpret=interpret,
+        shapes=(Q, S, H, D),
+    )
+    ddrop = None
+    if dropout_mask is not None:
+        import numpy as np
+
+        ddrop = np.zeros(dropout_mask.shape, dtype=jax.dtypes.float0)
+    return (
+        dq[:N].reshape(N, Q, H, D),
+        dk[:N].reshape(N, S, H, D),
+        dv[:N].reshape(N, S, H, D),
+        ddrop,
+    )
+
+
+_dep_graph_pallas.defvjp(_dep_graph_pallas_fwd, _dep_graph_pallas_bwd)
+
+
+def dep_graph_attention_pallas(
+    query: jnp.ndarray,
+    key: jnp.ndarray,
+    value: jnp.ndarray,
+    q_offset: int = 0,
+    window: int | None = None,
+    dropout_mask: jnp.ndarray | None = None,
+    dropout_rate: float = 0.0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The hand-tiled kernel behind ``dep_graph_attention(impl="pallas")``.
+
+    Same contract as the XLA formulation (``(N, Q, H, D)`` queries against
+    ``(N, S, H, D)`` keys/values, unscaled logits, fp32 softmax); see
+    `ops.band_attention.dep_graph_attention` for the dispatching wrapper
+    and the dropout-mask convention.
+    """
+    keep_prob = 1.0 - float(dropout_rate)
+    if dropout_mask is None:
+        keep_prob = 1.0
+    return _dep_graph_pallas(
+        query, key, value, dropout_mask, int(q_offset), window, keep_prob, bool(interpret)
+    )

@@ -40,20 +40,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-# jax renamed TPUCompilerParams → CompilerParams; accept both so the kernels
-# (and their interpret-mode tests) run on every jaxlib the fleet carries.
-_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+from .impl_select import LANE, compiler_params_cls, resolve_impl
+from .impl_select import round_up as _round_up
+
+# jaxlib-compat shim (TPUCompilerParams → CompilerParams) lives in
+# impl_select so all kernel modules track renames in one place.
+_CompilerParams = compiler_params_cls()
 
 __all__ = ["vocab_gather"]
 
-LANE = 128
 _ROW_TILE = 32
-
-
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
 
 
 def _fwd_kernel(z_ref, ci_ref, out_ref):
@@ -192,20 +189,18 @@ def vocab_gather(z: jnp.ndarray, ci: jnp.ndarray, impl: str | None = None) -> jn
             yields 0 for negative indices — used internally for tile
             padding — while the XLA fallback wraps NumPy-style).
         impl: ``None``/"auto" (Pallas kernel on TPU backends, XLA gather
-            elsewhere), ``"pallas"``, ``"pallas_interpret"`` (interpreter
-            mode, any backend — tests), or ``"xla"``.
+            elsewhere; overridable via ``$ESGPT_PALLAS_IMPL`` —
+            `ops.impl_select`), ``"pallas"``, ``"pallas_interpret"``
+            (interpreter mode, any backend — tests), or ``"xla"``.
 
     Returns:
         ``(..., M)`` fp32 gathered values. The backward pass produces a
         ``z``-dtype cotangent, accumulating duplicate indices in fp32 on
         the kernel path.
     """
-    if impl in (None, "auto"):
-        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    impl = resolve_impl(impl, "vocab_gather")
     if impl == "xla":
         return jnp.take_along_axis(z, ci, axis=-1).astype(jnp.float32)
-    if impl not in ("pallas", "pallas_interpret"):
-        raise ValueError(f"unknown vocab_gather impl {impl!r}")
     return _vocab_gather_kernel(
         z, ci, impl == "pallas_interpret", z.shape[-1], jnp.dtype(z.dtype)
     )

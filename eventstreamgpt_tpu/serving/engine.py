@@ -169,6 +169,27 @@ class GenerationEngine:
             decode on unpredictable rows.
         mesh: optional device mesh with a ``data`` axis; slots shard over it
             (``n_slots`` divisible by its size), params replicate.
+        sampling_impl: the decode sampling tail. ``None``/"auto"/"pallas"/
+            "pallas_interpret"/"xla" route every categorical head through
+            the fused filter+draw+merge op (`ops.fused_sampling
+            .fused_categorical`; auto = Pallas kernel on TPU) — bit-exact
+            vs the reference tail when ``top_k``/``top_p`` are off, so the
+            ``generate()`` parity contract is preserved. ``"multi_op"``
+            keeps the r07 per-op tail (the bench A/B baseline arm,
+            ``sampling_fused_ab_ms``).
+        top_k / top_p: optional tie-inclusive sampling filters applied to
+            every categorical head by the fused tail (serving-quality
+            knobs; they deliberately change the sampled distribution, so
+            parity vs ``generate()`` holds only when both are ``None``).
+        kv_cache_dtype: the decode KV-cache element type. ``None`` keeps
+            the model compute dtype (the parity-exact default); ``"bf16"``
+            / ``"fp32"`` pin a float width; ``"int8"`` (and ``"fp8"``
+            where the jaxlib carries ``float8_e4m3fn``) store quantized
+            K/V planes with per-head-per-row fp32 scale tables —
+            quantize-on-admission + quantize-on-write at the decode
+            cursor, dequantized on read inside the attention contraction
+            (`ops.kv_quant`; docs/serving.md "Quantized decode cache" for
+            the tolerance contract and the slots-per-chip math).
     """
 
     def __init__(
@@ -189,6 +210,10 @@ class GenerationEngine:
         device_criteria: Sequence[DeviceCriterion] = (),
         stop_dead_rows: bool = True,
         mesh: Optional[Mesh] = None,
+        sampling_impl: str | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        kv_cache_dtype: str | None = None,
     ):
         self.model = model
         self.params = params
@@ -218,6 +243,54 @@ class GenerationEngine:
         if base_key is None:
             base_key = jax.random.PRNGKey(0)
         self._base_key = _as_raw_key(base_key)
+
+        # Decode sampling tail: fused filter+draw+merge by default (bit-
+        # exact vs the multi-op reference when unfiltered), "multi_op" for
+        # the r07 baseline arm.
+        self.sampling_impl = sampling_impl
+        self.top_k = None if top_k is None else int(top_k)
+        self.top_p = None if top_p is None else float(top_p)
+        if sampling_impl == "multi_op":
+            if self.top_k is not None or self.top_p is not None:
+                raise ValueError(
+                    "top_k/top_p filtering requires the fused sampling tail; "
+                    "drop sampling_impl='multi_op'"
+                )
+            self._categorical_sampler = None
+            self.sampling_impl_resolved = "multi_op"
+        else:
+            from ..ops.fused_sampling import fused_categorical
+            from ..ops.impl_select import resolve_impl
+
+            impl = sampling_impl
+            if impl in (None, "auto") and mesh is not None and mesh.devices.size > 1:
+                # The sampling kernel's grid slices the slot axis, which is
+                # exactly the sharded mesh axis: SPMD would all-gather the
+                # (n_slots, V) logits plane into the decode hot loop
+                # (caught by the engine_kvq_dp8 budget gate). Auto falls
+                # back to the fused-XLA tail on multi-device meshes — still
+                # bit-exact; an explicit "pallas" request is honored.
+                impl = "xla"
+            # Resolve eagerly (freezing the env/backend choice at engine
+            # construction) so stats()/bench can report WHICH tail actually
+            # runs — "fused_auto" would hide the mesh degrade above.
+            impl = resolve_impl(impl, "fused_categorical")
+            self.sampling_impl_resolved = f"fused_{impl}"
+            self._categorical_sampler = functools.partial(
+                fused_categorical,
+                top_k=self.top_k,
+                top_p=self.top_p,
+                impl=impl,
+            )
+
+        # Decode KV-cache element type (seq caches only — the NA dep-graph
+        # caches are a few positions wide and stay in the compute dtype).
+        from ..ops.kv_quant import resolve_cache_dtype
+
+        self.kv_cache_dtype = kv_cache_dtype
+        self._kv_buf_dtype, self._kv_quantized = resolve_cache_dtype(
+            kv_cache_dtype, config.compute_dtype
+        )
 
         mode = config.structured_event_processing_mode
         self._is_na = mode == StructuredEventProcessingMode.NESTED_ATTENTION
@@ -310,7 +383,9 @@ class GenerationEngine:
         )
         seq_caches = tuple(
             kv.replace(length=jnp.zeros((S,), jnp.int32))
-            for kv in init_kv_caches(self.config, S, max_len=L)
+            for kv in init_kv_caches(
+                self.config, S, max_len=L, cache_dtype=self.kv_cache_dtype
+            )
         )
         if self._is_na:
             n_levels = len(self._measurements_to_fill_list)
@@ -353,10 +428,31 @@ class GenerationEngine:
         return jax.tree_util.tree_map(spec, self._state)
 
     # --------------------------------------------------------- device pieces
-    def _sample_rows(self, preds_last, em_last, step_keys):
+    def _sample_rows(self, preds_last, em_last, step_keys, active=None):
         """Per-slot sampling with per-slot keys: each row draws exactly what a
-        B=1 ``generate()`` with that key would (vmapped `sample_predictions`)."""
-        return jax.vmap(sample_predictions)(preds_last, em_last, step_keys)
+        B=1 ``generate()`` with that key would (vmapped `sample_predictions`).
+
+        With the fused tail (the default), every categorical head runs as
+        one filter+gumbel+argmax pass (`ops.fused_sampling`) and, on decode
+        steps, the per-slot ``where(active)`` freeze rides the same scope
+        (inactive slots draw ``fill`` without touching results — their rows
+        are frozen by the step's merges regardless). Bit-exact vs the
+        multi-op tail when ``top_k``/``top_p`` are off.
+        """
+        base = self._categorical_sampler
+        if base is None:
+            return jax.vmap(sample_predictions)(preds_last, em_last, step_keys)
+        if active is None:
+            row = lambda p, e, k: sample_predictions(  # noqa: E731
+                p, e, k, categorical_sampler=base
+            )
+            return jax.vmap(row)(preds_last, em_last, step_keys)
+
+        def row_active(p, e, k, a):
+            sampler = functools.partial(base, active=a)
+            return sample_predictions(p, e, k, categorical_sampler=sampler)
+
+        return jax.vmap(row_active)(preds_last, em_last, step_keys, active)
 
     def _row_done(self, big, cursor, base_len, n_generated, budget):
         done = (cursor - base_len) >= budget
@@ -405,7 +501,7 @@ class GenerationEngine:
         )
         preds_last = _slice_preds_at(out.preds, jnp.asarray(0))
         em_last = take_event(st.big.event_mask, st.cursor - 1)
-        sample = self._sample_rows(preds_last, em_last, step_keys)
+        sample = self._sample_rows(preds_last, em_last, step_keys, active=active)
         big2 = append_new_event(st.big, sample, config, st.cursor)
         big2 = update_last_event_data(big2, sample, config, st.cursor + 1)
 
@@ -453,7 +549,7 @@ class GenerationEngine:
         )
         preds_last = _slice_preds_at(out.preds, jnp.asarray(0))
         em_last = take_event(st.big.event_mask, st.cursor - 1)
-        sample = self._sample_rows(preds_last, em_last, step_keys)
+        sample = self._sample_rows(preds_last, em_last, step_keys, active=active)
         big = append_new_event(st.big, sample, config, st.cursor)
         n_generated = st.n_generated + (active & sample.event_mask)
         past = out.past_key_values
@@ -472,7 +568,7 @@ class GenerationEngine:
             past = out.past_key_values
             preds_last = _slice_preds_at(out.preds, jnp.asarray(0))
             em_last = take_event(big.event_mask, st.cursor)
-            sample = self._sample_rows(preds_last, em_last, step_keys)
+            sample = self._sample_rows(preds_last, em_last, step_keys, active=active)
             big = update_last_event_data(
                 big,
                 sample,
@@ -637,17 +733,35 @@ class GenerationEngine:
         big = scatter(state.big, big1)
 
         def scatter_kv(dst: KVCache, src: KVCache, vector_len: bool) -> KVCache:
-            return KVCache(
-                key=dst.key.at[slots].set(src.key.astype(dst.key.dtype), mode="drop"),
-                value=dst.value.at[slots].set(
+            if dst.key_scale is not None:
+                # Quantize-on-admission: prefill ran (exactly) on float
+                # caches; the admitted rows land in the slot cache as
+                # int8/fp8 planes + per-head-per-row scales (ops/kv_quant).
+                from ..ops.kv_quant import quantize_kv
+
+                k_q, k_s = quantize_kv(src.key, dst.key.dtype)
+                v_q, v_s = quantize_kv(src.value, dst.value.dtype)
+                key = dst.key.at[slots].set(k_q, mode="drop")
+                value = dst.value.at[slots].set(v_q, mode="drop")
+                key_scale = dst.key_scale.at[slots].set(k_s, mode="drop")
+                value_scale = dst.value_scale.at[slots].set(v_s, mode="drop")
+            else:
+                key = dst.key.at[slots].set(src.key.astype(dst.key.dtype), mode="drop")
+                value = dst.value.at[slots].set(
                     src.value.astype(dst.value.dtype), mode="drop"
-                ),
+                )
+                key_scale = value_scale = None
+            return KVCache(
+                key=key,
+                value=value,
                 mask=dst.mask.at[slots].set(src.mask, mode="drop"),
                 length=(
                     dst.length.at[slots].set(plen, mode="drop")
                     if vector_len
                     else src.length
                 ),
+                key_scale=key_scale,
+                value_scale=value_scale,
             )
 
         if self._is_na:
@@ -976,6 +1090,70 @@ class GenerationEngine:
         )
 
     # ---------------------------------------------------------- accounting
+    def slots_report(self, hbm_gb: float = 16.0) -> dict:
+        """Per-cache-dtype HBM capacity accounting (no allocation).
+
+        For each supported cache dtype (`ops.kv_quant.CACHE_DTYPES`):
+        the seq KV-cache bytes one decode slot pins at this engine's
+        ``max_len`` (planes + scale tables for quantized dtypes), and the
+        max admissible slot count against an ``hbm_gb`` budget net of the
+        replicated parameters and the per-slot content rows. The active
+        dtype and its slot-capacity ratio vs bf16 head the report — the
+        bench surfaces the ratio as ``kvq_slots_per_chip_ratio``.
+        """
+        from ..ops.kv_quant import (
+            CACHE_DTYPES,
+            cache_dtype_name,
+            kv_cache_bytes_per_slot,
+        )
+
+        cfg = self.config
+        # Non-cache per-slot state: the content rows + cursors (and the NA
+        # dep-graph caches, which stay in the compute dtype by design).
+        state_bytes = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(self._state)
+        )
+        seq_caches = (
+            self._state.caches.seq_past if self._is_na else self._state.caches
+        )
+        seq_cache_bytes = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(seq_caches)
+        )
+        row_bytes = max((state_bytes - seq_cache_bytes) // self.n_slots, 1)
+        params_bytes = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(self.params)
+        )
+        budget = max(int(hbm_gb * 1e9) - params_bytes, 0)
+
+        per_dtype = {}
+        for name in CACHE_DTYPES:
+            kv_bytes = kv_cache_bytes_per_slot(
+                cfg.num_hidden_layers,
+                cfg.num_attention_heads,
+                self.max_len,
+                cfg.head_dim,
+                name,
+                cfg.compute_dtype,
+            )
+            per_dtype[name] = {
+                "kv_bytes_per_slot": kv_bytes,
+                "max_slots": int(budget // (kv_bytes + row_bytes)),
+            }
+        # Canonical name (not the raw constructor string — aliases like
+        # "bfloat16"/"f32" are accepted and must index per_dtype).
+        active_name = cache_dtype_name(self._kv_buf_dtype)
+        ratio = per_dtype[active_name]["max_slots"] / max(
+            per_dtype["bf16"]["max_slots"], 1
+        )
+        return {
+            "kv_cache_dtype": active_name,
+            "hbm_budget_gb": hbm_gb,
+            "params_bytes": params_bytes,
+            "row_bytes_per_slot": int(row_bytes),
+            "per_dtype": per_dtype,
+            "slots_per_chip_ratio_vs_bf16": round(ratio, 3),
+        }
+
     def stats(self) -> dict:
         total = self._dispatched_chunks * self.decode_chunk * self.n_slots
         active = int(np.asarray(self._state.active_steps))  # graftcheck: allow GC001 -- post-run accounting readback
@@ -990,6 +1168,8 @@ class GenerationEngine:
                 "slot_steps": total,
                 "active_slot_steps": active,
                 "wasted_decode_frac": round(1.0 - active / max(total, 1), 4),
+                "sampling_impl": self.sampling_impl_resolved,
+                "slots_report": self.slots_report(),
             }
         )
         return report

@@ -80,3 +80,78 @@ def test_sustained_step_threads_state():
     # k_min calibration steps + 2 windows of k steps each.
     assert float(out_state) == 4 + 2 * info["k"]
     assert np.isfinite(out_ms)
+
+
+class TestBenchTailCapture:
+    """The driver keeps only the FINAL 2000 characters of bench stdout; the
+    headline keys must therefore (a) sit after the headline-block marker in
+    the print dict and (b) render small enough that the whole headline
+    block fits the window. Statically checked against bench.py's source so
+    a reordering or a bloated tail fails in tier-1, not in a lost artifact."""
+
+    HEADLINE_MARKER = "---- headline block"
+    # Every r09 key the acceptance list names, plus the historical headline
+    # keys whose position the r06/r07/r08 rounds already relied on.
+    REQUIRED_TAIL_KEYS = [
+        "width1024_remat_ab_ms",
+        "na_fused_ab_probe_ms",
+        "dep_graph_pallas_ab_ms",
+        "engine_events_per_sec_per_chip",
+        "sampling_fused_ab_ms",
+        "kvq_engine_events_per_sec_per_chip",
+        "kvq_slots_per_chip_ratio",
+        "service_p95_latency_ms",
+        "zeroshot_auroc",
+        "value",
+    ]
+
+    def _tail_keys(self):
+        import pathlib
+        import re
+
+        src = (pathlib.Path(__file__).parent.parent / "bench.py").read_text()
+        marker = src.index(self.HEADLINE_MARKER)
+        tail_src = src[marker:]
+        return re.findall(r'^\s+"([a-z0-9_]+)":', tail_src, flags=re.M)
+
+    def test_required_keys_sit_in_the_headline_block_in_order(self):
+        keys = self._tail_keys()
+        positions = []
+        for k in self.REQUIRED_TAIL_KEYS:
+            assert k in keys, f"headline key {k!r} fell out of the tail block"
+            positions.append(keys.index(k))
+        assert positions == sorted(positions), "headline keys reordered"
+        assert keys[-1] == "value", "the driver's metric key must print last"
+
+    def test_headline_block_fits_the_2000_char_capture(self):
+        """Render the tail with representative value widths: scalars ~8
+        chars, the A/B dicts ~3 arms of rounded ms, rate lists ~3 epochs.
+        The estimate must clear the window with margin for real values."""
+        import json
+
+        def fake_value(key):
+            if key == "na_fused_ab_probe_ms":  # 4 arms since r09
+                return {
+                    "fused_narrow_default": 9999.99,
+                    "unfused_attention": 9999.99,
+                    "full_plane_heads": 9999.99,
+                    "dep_graph_xla_fused": 9999.99,
+                }
+            if key.endswith("_ab_ms"):
+                return {"first_arm_name_here": 9999.99, "second_arm_name": 9999.99}
+            if key.endswith("_rates"):
+                return [99999.9, 99999.9, 99999.9]
+            if key in ("metric", "unit"):
+                return "pretrain_events_per_sec_per_chip"
+            if key.endswith(("_policy", "_winner")):
+                return "save_attention"
+            return 99999.999
+
+        # The regex also catches the A/B dicts' inner arm keys; drop them
+        # (their width is already counted through fake_value's dicts).
+        keys = [k for k in self._tail_keys() if not k.endswith(("_arm", "_default", "_fused", "_tail", "_heads", "_attention"))]
+        rendered = json.dumps({k: fake_value(k) for k in keys})
+        assert len(rendered) < 1900, (
+            f"headline block renders to ~{len(rendered)} chars; the driver "
+            "captures 2000 — move detail keys above the marker"
+        )

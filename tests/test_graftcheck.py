@@ -655,6 +655,38 @@ class TestLoweredProgramGates:
             assert check_no_f64(text, f"engine:{label}") == []
             assert check_no_host_transfers(text, f"engine:{label}") == []
 
+    def test_kvq_and_pallas_programs_are_f64_and_host_transfer_free(self):
+        """The r09 kernel-round programs: the int8-cache engine decode on
+        dp8 (quantize-on-write / dequantize-on-read must add no host
+        traffic and no f64 — the scale tables are fp32 by design, not
+        f64), the unsharded Pallas fused-sampling decode program, and the
+        Pallas dep-graph-kernel NA pretrain step (the custom_vjp pair must
+        not smuggle callbacks into fwd or bwd)."""
+        from eventstreamgpt_tpu.analysis.program_checks import (
+            canonical_kvq_engine_programs,
+            canonical_pretrain_step,
+            canonical_sampling_engine_program,
+            check_no_f64,
+            check_no_host_transfers,
+        )
+
+        programs = canonical_kvq_engine_programs(8)
+        assert set(programs) == {"decode", "prefill_b8", "boundary_pack"}
+        for label, (fn, args) in programs.items():
+            text = fn.lower(*args).as_text()
+            assert check_no_f64(text, f"engine_kvq:{label}") == []
+            assert check_no_host_transfers(text, f"engine_kvq:{label}") == []
+
+        fn, args = canonical_sampling_engine_program()["decode"]
+        text = fn.lower(*args).as_text()
+        assert check_no_f64(text, "engine_sampling:decode") == []
+        assert check_no_host_transfers(text, "engine_sampling:decode") == []
+
+        fn, args = canonical_pretrain_step(8, 1, na=True, na_impl="pallas_interpret")
+        text = fn.lower(*args).as_text()
+        assert check_no_f64(text, "pretrain:na_pallas_dp8") == []
+        assert check_no_host_transfers(text, "pretrain:na_pallas_dp8") == []
+
     def test_service_programs_are_f64_and_host_transfer_free(self):
         """The online service's dispatch programs (2-replica service over
         dp8): the async double-buffered pipeline is only host-transfer-free

@@ -1,0 +1,436 @@
+"""Quantized decode KV caches (`ops/kv_quant.py` + the transformer/engine wiring).
+
+The documented tolerance contract (docs/serving.md "Quantized decode
+cache"):
+
+* **structure / integers exact**: an int8-cache engine (and the service
+  over it) reproduces the float-cache ``generate()`` trajectory's event
+  masks, event counts, and every integer field exactly at the pinned
+  seeds (per-head-per-row absmax int8 perturbs decode logits by well
+  under the sampled draws' decision margins on these models);
+* **floats within tolerance**: ``time_delta`` / ``dynamic_values`` agree
+  to ``rtol=2e-2`` (int8 carries ~0.4% per-element error; the tolerance
+  leaves headroom for accumulation over the horizon);
+* **training / prefill untouched**: quantization lives only in the cache
+  buffers the decode loop persists — prefill runs on float caches and is
+  quantized at admission.
+
+Also pinned here (satellite): float-cache **dtype preservation** through
+both `KVCache.length` branches — a bf16 cache must come back bf16 from
+the one-hot scatter (vector) write path, which used to silently promote
+through ``jnp.where``, and from the ``dynamic_update_slice`` (scalar)
+path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.models.transformer import (
+    ConditionallyIndependentPointProcessTransformer,
+    init_kv_caches,
+)
+from eventstreamgpt_tpu.ops.kv_quant import (
+    CACHE_DTYPES,
+    HAS_FP8,
+    dequantize_kv,
+    kv_cache_bytes_per_slot,
+    quantize_kv,
+    resolve_cache_dtype,
+)
+
+from .models.test_transformer import make_batch, small_config
+
+FLOAT_TOL = dict(rtol=2e-2, atol=2e-2)
+
+
+class TestQuantOps:
+    def test_int8_roundtrip_error_bound(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 3, 16, 8)).astype(np.float32))
+        q, scale = quantize_kv(x, jnp.int8)
+        assert q.dtype == jnp.int8 and scale.shape == x.shape[:-1]
+        deq = dequantize_kv(q, scale, jnp.float32)
+        # Symmetric absmax with round-to-nearest: error <= scale/2 per lane.
+        bound = np.asarray(scale)[..., None] * 0.5 + 1e-8
+        assert (np.abs(np.asarray(deq) - np.asarray(x)) <= bound).all()
+
+    def test_zero_rows_are_stable(self):
+        x = jnp.zeros((2, 4, 8))
+        q, scale = quantize_kv(x, jnp.int8)
+        np.testing.assert_array_equal(np.asarray(scale), 1.0)
+        np.testing.assert_array_equal(np.asarray(dequantize_kv(q, scale, jnp.float32)), 0.0)
+
+    @pytest.mark.skipif(not HAS_FP8, reason="jaxlib without float8_e4m3fn")
+    def test_fp8_roundtrip_close(self):
+        from eventstreamgpt_tpu.ops.kv_quant import FP8_DTYPE
+
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 16)).astype(np.float32))
+        q, scale = quantize_kv(x, FP8_DTYPE)
+        assert q.dtype == FP8_DTYPE
+        np.testing.assert_allclose(
+            np.asarray(dequantize_kv(q, scale, jnp.float32)), np.asarray(x), rtol=0.1, atol=0.1
+        )
+
+    def test_resolve_cache_dtype(self):
+        assert resolve_cache_dtype(None, jnp.bfloat16) == (jnp.dtype(jnp.bfloat16), False)
+        assert resolve_cache_dtype("fp32", jnp.bfloat16) == (jnp.dtype(jnp.float32), False)
+        assert resolve_cache_dtype("int8", jnp.float32) == (jnp.dtype(jnp.int8), True)
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            resolve_cache_dtype("int4", jnp.float32)
+
+    def test_cache_dtype_name_canonicalizes_aliases(self):
+        from eventstreamgpt_tpu.ops.kv_quant import cache_dtype_name
+
+        for alias, canonical in (
+            ("bfloat16", "bf16"),
+            ("f32", "fp32"),
+            ("float32", "fp32"),
+            ("int8", "int8"),
+        ):
+            assert cache_dtype_name(resolve_cache_dtype(alias, jnp.float32)[0]) == canonical
+
+    def test_bytes_per_slot_ordering_at_production_width(self):
+        # head_dim 128: the scale overhead (4B per 128 lanes) is marginal,
+        # so the capacity ladder must hold strictly.
+        b = {
+            name: kv_cache_bytes_per_slot(12, 8, 1024, 128, name)
+            for name in CACHE_DTYPES
+        }
+        assert b["int8"] < b["bf16"] < b["fp32"]
+        assert b["bf16"] / b["int8"] > 1.9  # ~2x slots-per-chip at bf16->int8
+        if HAS_FP8:
+            assert b["fp8"] == b["int8"]
+
+
+class TestQuantizedCacheDecode:
+    """Encoder-level: both `length` branches, quantized vs float caches."""
+
+    def setup_method(self):
+        self.config = small_config()
+        self.batch = make_batch()
+        self.model = ConditionallyIndependentPointProcessTransformer(self.config)
+        self.params = self.model.init(jax.random.PRNGKey(0), self.batch)
+
+    def _decode(self, cache_dtype, vector_length=False):
+        B, L = self.batch.event_mask.shape
+        prefix = self.batch.slice((slice(None), slice(0, L - 1)))
+        last = self.batch.slice((slice(None), slice(L - 1, L)))
+        past = init_kv_caches(self.config, B, max_len=L, cache_dtype=cache_dtype)
+        out1 = self.model.apply(self.params, prefix, past=past, use_cache=True)
+        past = out1.past_key_values
+        if vector_length:
+            past = tuple(
+                kv.replace(length=jnp.full((B,), kv.length, jnp.int32)) for kv in past
+            )
+        out2 = self.model.apply(self.params, last, past=past, use_cache=True)
+        return out1, out2
+
+    def test_scalar_branch_int8_close_to_float(self):
+        _, ref = self._decode(None)
+        _, q = self._decode("int8")
+        np.testing.assert_allclose(
+            np.asarray(q.last_hidden_state), np.asarray(ref.last_hidden_state), **FLOAT_TOL
+        )
+
+    def test_vector_branch_int8_close_to_float(self):
+        _, ref = self._decode(None, vector_length=True)
+        _, q = self._decode("int8", vector_length=True)
+        np.testing.assert_allclose(
+            np.asarray(q.last_hidden_state), np.asarray(ref.last_hidden_state), **FLOAT_TOL
+        )
+
+    def test_scalar_and_vector_quantized_branches_bit_equal(self):
+        """The r07 scalar-vs-vector op-for-op pin extends to quantized
+        caches: same chunk -> same quantized values + scales -> identical
+        attention, whichever write path ran."""
+        _, a = self._decode("int8")
+        _, b = self._decode("int8", vector_length=True)
+        np.testing.assert_array_equal(
+            np.asarray(a.last_hidden_state), np.asarray(b.last_hidden_state)
+        )
+
+    def test_quantized_present_carries_int8_planes_and_scales(self):
+        out1, out2 = self._decode("int8")
+        for out in (out1, out2):
+            for kv in out.past_key_values:
+                assert kv.key.dtype == jnp.int8 and kv.value.dtype == jnp.int8
+                assert kv.key_scale.dtype == jnp.float32
+                assert kv.key_scale.shape == kv.key.shape[:-1]
+        # Written positions carry real scales (not the init placeholder 1.0).
+        ks = np.asarray(out2.past_key_values[0].key_scale)
+        L = self.batch.event_mask.shape[1]
+        assert (ks[:, :, :L] != 1.0).any()
+
+    def test_float_paths_have_no_scale_leaves(self):
+        _, out = self._decode(None)
+        for kv in out.past_key_values:
+            assert kv.key_scale is None and kv.value_scale is None
+
+
+class TestKVCacheDtypePreservation:
+    """Satellite regression: bf16 caches must stay bf16 through BOTH write
+    branches (fp32 compute writes used to promote the one-hot scatter path)."""
+
+    def setup_method(self):
+        self.config = small_config()  # fp32 compute dtype
+        self.batch = make_batch()
+        self.model = ConditionallyIndependentPointProcessTransformer(self.config)
+        self.params = self.model.init(jax.random.PRNGKey(0), self.batch)
+
+    @pytest.mark.parametrize("vector_length", [False, True], ids=["scalar", "vector"])
+    def test_bf16_cache_stays_bf16(self, vector_length):
+        B, L = self.batch.event_mask.shape
+        prefix = self.batch.slice((slice(None), slice(0, L - 1)))
+        last = self.batch.slice((slice(None), slice(L - 1, L)))
+        past = init_kv_caches(self.config, B, max_len=L, dtype=jnp.bfloat16)
+        out1 = self.model.apply(self.params, prefix, past=past, use_cache=True)
+        past = out1.past_key_values
+        for kv in past:
+            assert kv.key.dtype == jnp.bfloat16 and kv.value.dtype == jnp.bfloat16
+        if vector_length:
+            past = tuple(
+                kv.replace(length=jnp.full((B,), kv.length, jnp.int32)) for kv in past
+            )
+        out2 = self.model.apply(self.params, last, past=past, use_cache=True)
+        for kv in out2.past_key_values:
+            assert kv.key.dtype == jnp.bfloat16, "cache silently upcast on write"
+            assert kv.value.dtype == jnp.bfloat16
+
+
+class TestQuantizedParityTier1:
+    """The compact acceptance pin, IN TIER-1 (the test_service precedent of
+    keeping one model-building parity test in the fast loop): an int8-cache
+    CI engine and an int8-cache service replica both reproduce the float
+    ``generate()`` trajectories — structure/integers exact, floats within
+    the documented tolerance. The broader matrix (NA, chunking
+    determinism, fp8, adversarial service geometry) runs in the slow
+    chunk below."""
+
+    def test_int8_engine_and_service_match_generate(self):
+        from eventstreamgpt_tpu.generation import generate
+        from eventstreamgpt_tpu.serving import ServingService
+
+        from .test_service import build_ci, engine_for, mixed_requests
+
+        ci = build_ci()
+        config, model, params, prompt = ci
+        key = jax.random.PRNGKey(7)
+        eng_results = engine_for(
+            ci, dispatch_depth=1, base_key=key, kv_cache_dtype="int8"
+        ).run(mixed_requests(prompt))
+        svc_results = ServingService(
+            [engine_for(ci, dispatch_depth=2, kv_cache_dtype="int8")], base_key=key
+        ).run(mixed_requests(prompt))
+        reqs = mixed_requests(prompt)
+        for results in (eng_results, svc_results):
+            assert len(results) == len(reqs)
+            for r in results:
+                req = reqs[r.request_id]
+                ref = generate(
+                    model,
+                    params,
+                    req.prompt,
+                    config,
+                    jax.random.fold_in(key, r.admission_index),
+                    max_new_events=req.max_new_events,
+                    return_output=True,
+                ).batch
+                n = r.n_events
+                np.testing.assert_array_equal(
+                    np.asarray(r.batch.event_mask), np.asarray(ref.event_mask)[:, :n]
+                )
+                for f in (
+                    "dynamic_indices",
+                    "dynamic_measurement_indices",
+                    "dynamic_values_mask",
+                ):
+                    np.testing.assert_array_equal(
+                        np.asarray(getattr(r.batch, f)),
+                        np.asarray(getattr(ref, f))[:, :n],
+                        err_msg=f,
+                    )
+                for f in ("time_delta", "dynamic_values"):
+                    np.testing.assert_allclose(
+                        np.asarray(getattr(r.batch, f)),
+                        np.asarray(getattr(ref, f))[:, :n],
+                        err_msg=f,
+                        **FLOAT_TOL,
+                    )
+
+
+@pytest.mark.slow
+class TestEngineQuantizedParity:
+    """int8-cache engine vs float generate(): structure/integers exact,
+    floats within the documented tolerance — the acceptance pin."""
+
+    @pytest.mark.parametrize("kind", ["ci", "na"])
+    def test_engine_int8_matches_generate(self, kind):
+        from .test_engine import build, engine_for, mixed_requests, reference_for
+
+        config, model, params, prompt = build(kind)
+        reqs = mixed_requests(prompt)
+        eng = engine_for(model, params, config, prompt, kv_cache_dtype="int8")
+        results = eng.run(reqs)
+        assert len(results) == len(reqs)
+        for r in results:
+            ref = reference_for(model, params, config, reqs[r.request_id]).batch
+            n = r.n_events
+            np.testing.assert_array_equal(
+                np.asarray(r.batch.event_mask), np.asarray(ref.event_mask)[:, :n]
+            )
+            for f in (
+                "dynamic_indices",
+                "dynamic_measurement_indices",
+                "dynamic_values_mask",
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(r.batch, f)),
+                    np.asarray(getattr(ref, f))[:, :n],
+                    err_msg=f,
+                )
+            for f in ("time_delta", "dynamic_values"):
+                np.testing.assert_allclose(
+                    np.asarray(getattr(r.batch, f)),
+                    np.asarray(getattr(ref, f))[:, :n],
+                    err_msg=f,
+                    **FLOAT_TOL,
+                )
+
+    def test_engine_int8_is_deterministic_across_chunking(self):
+        from .test_engine import build, engine_for, mixed_requests
+
+        config, model, params, prompt = build("ci")
+        reqs = mixed_requests(prompt)
+        a = engine_for(model, params, config, prompt, kv_cache_dtype="int8").run(reqs)
+        b = engine_for(
+            model, params, config, prompt, kv_cache_dtype="int8", decode_chunk=3, n_slots=3
+        ).run(reqs)
+        for ra, rb in zip(a, b):
+            assert ra.n_events == rb.n_events and ra.n_generated == rb.n_generated
+            np.testing.assert_array_equal(
+                np.asarray(ra.batch.event_mask), np.asarray(rb.batch.event_mask)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(ra.batch.time_delta), np.asarray(rb.batch.time_delta)
+            )
+
+    @pytest.mark.skipif(not HAS_FP8, reason="jaxlib without float8_e4m3fn")
+    def test_engine_fp8_runs_and_matches_structure(self):
+        """fp8 is the capacity-parity cousin of int8 (same bytes/slot);
+        e4m3's ~2 decimal digits are looser than int8's absmax grid, so
+        only the structural half of the contract is pinned for it."""
+        from .test_engine import build, engine_for, mixed_requests, reference_for
+
+        config, model, params, prompt = build("ci")
+        reqs = mixed_requests(prompt)
+        eng = engine_for(model, params, config, prompt, kv_cache_dtype="fp8")
+        results = eng.run(reqs)
+        assert len(results) == len(reqs)
+        for r in results:
+            ref = reference_for(model, params, config, reqs[r.request_id]).batch
+            np.testing.assert_array_equal(
+                np.asarray(r.batch.event_mask),
+                np.asarray(ref.event_mask)[:, : r.n_events],
+            )
+            assert np.isfinite(np.asarray(r.batch.time_delta)).all()
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+class TestServiceQuantizedParity:
+    """The service path of the acceptance pin: an int8-cache replica behind
+    `ServingService` is bit-identical to the int8 sync engine, and holds
+    the same documented tolerance vs float generate()."""
+
+    def test_service_int8_bit_identical_to_int8_engine(self):
+        from eventstreamgpt_tpu.serving import ServingService
+
+        from .test_service import build_ci, engine_for, mixed_requests
+
+        ci = build_ci()
+        _, _, _, prompt = ci
+        key = jax.random.PRNGKey(7)
+        sync = engine_for(ci, dispatch_depth=1, base_key=key, kv_cache_dtype="int8").run(
+            mixed_requests(prompt)
+        )
+        svc = ServingService(
+            [engine_for(ci, dispatch_depth=2, kv_cache_dtype="int8")], base_key=key
+        ).run(mixed_requests(prompt))
+        assert [r.admission_index for r in svc] == [r.admission_index for r in sync]
+        for a, b in zip(sync, svc):
+            assert a.n_events == b.n_events and a.n_generated == b.n_generated
+            for f in (
+                "event_mask",
+                "time_delta",
+                "dynamic_indices",
+                "dynamic_measurement_indices",
+                "dynamic_values",
+                "dynamic_values_mask",
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a.batch, f)), np.asarray(getattr(b.batch, f)), err_msg=f
+                )
+
+    def test_service_int8_matches_generate_within_tolerance(self):
+        from eventstreamgpt_tpu.generation import generate
+        from eventstreamgpt_tpu.serving import ServingService
+
+        from .test_service import build_ci, engine_for, mixed_requests
+
+        ci = build_ci()
+        config, model, params, prompt = ci
+        reqs = mixed_requests(prompt)
+        svc = ServingService(
+            [engine_for(ci, dispatch_depth=2, kv_cache_dtype="int8")],
+            base_key=jax.random.PRNGKey(7),
+        ).run(list(reqs))
+        for r in svc:
+            req = reqs[r.request_id]
+            # Service requests carry no explicit key: accepted request i
+            # runs with fold_in(service_key, i) (the service determinism
+            # contract), which the generate() reference must mirror.
+            key = jax.random.fold_in(jax.random.PRNGKey(7), r.admission_index)
+            ref = generate(
+                model, params, req.prompt, config, key,
+                max_new_events=req.max_new_events, return_output=True,
+            ).batch
+            n = r.n_events
+            np.testing.assert_array_equal(
+                np.asarray(r.batch.event_mask), np.asarray(ref.event_mask)[:, :n]
+            )
+            np.testing.assert_array_equal(
+                np.asarray(r.batch.dynamic_indices),
+                np.asarray(ref.dynamic_indices)[:, :n],
+            )
+            np.testing.assert_allclose(
+                np.asarray(r.batch.time_delta),
+                np.asarray(ref.time_delta)[:, :n],
+                **FLOAT_TOL,
+            )
+
+
+@pytest.mark.slow
+class TestSlotsReport:
+    def test_slots_report_shape_and_capacity_ordering(self):
+        from .test_engine import build, engine_for
+
+        config, model, params, prompt = build("ci")
+        eng = engine_for(model, params, config, prompt, kv_cache_dtype="int8")
+        rep = eng.slots_report(hbm_gb=16.0)
+        assert rep["kv_cache_dtype"] == "int8"
+        assert set(CACHE_DTYPES) <= set(rep["per_dtype"])
+        for name in CACHE_DTYPES:
+            entry = rep["per_dtype"][name]
+            assert entry["kv_bytes_per_slot"] > 0 and entry["max_slots"] > 0
+        assert (
+            rep["per_dtype"]["int8"]["kv_bytes_per_slot"]
+            <= rep["per_dtype"]["bf16"]["kv_bytes_per_slot"]
+            <= rep["per_dtype"]["fp32"]["kv_bytes_per_slot"]
+        )
+        # And it rides the engine's stats()/padding_report surface.
+        stats = eng.stats()
+        assert stats["slots_report"]["kv_cache_dtype"] == "int8"
+        # The RESOLVED tail, not the constructor string: auto on an
+        # unsharded CPU engine is the fused-XLA tail.
+        assert stats["sampling_impl"] == "fused_xla"

@@ -16,6 +16,8 @@ import pytest
 
 from eventstreamgpt_tpu.ops.pallas_heads import vocab_gather
 
+pytestmark = pytest.mark.pallas
+
 ON_TPU = jax.default_backend() == "tpu"
 
 
