@@ -90,7 +90,20 @@ MEASURED_EPOCHS = 3
 # Production-width probe shape (VERDICT r03 #2): the toy-size epochs above
 # are dispatch/overhead-dominated; this point shows whether the stack holds
 # MFU at realistic width.
-WIDE_HIDDEN, WIDE_LAYERS, WIDE_HEADS = 1024, 12, 8
+#
+# The width ladder (r10 scale-up round) grows that single point into a
+# measured axis: rung 0 is the historical width-1024 probe (the r06 remat
+# A/B still carries the headline MFU), and every higher rung reuses the
+# same packed seq-1024 bf16+Pallas arm at 12 layers with scan-over-layers.
+# Per-rung HBM accounting (training/sharding.train_state_bytes vs the
+# documented per-chip budget) decides the layout: replicated while the
+# train state fits, FSDP over all local chips once it does not — the 4096
+# rung is FSDP-only by that accounting, which is the point of the round.
+WIDTH_LADDER = (1024, 2048, 4096)
+WIDE_HIDDEN = WIDTH_LADDER[0]
+WIDE_LAYERS, WIDE_HEADS = 12, 8
+HBM_BUDGET_GB = 16.0  # documented per-chip HBM budget the ladder fits against
+HBM_HEADROOM = 0.8  # train-state share; activations/XLA scratch take the rest
 
 ETL_SUBJECTS = 20000  # ~1.7M post-agg events: MIMIC-scale ETL (VERDICT r03 #5)
 
@@ -1050,6 +1063,223 @@ def main():
     # v5e bf16 peak — the dtype-matched MFU floor estimate.
     wide_mfu = wide_probe_rate * 6 * wide_params / 197e12
 
+    # ---- width ladder (r10): width as a measured scaling axis. Rung 0 is
+    # the probe above; higher rungs compile with scan_layers=True (one
+    # scanned block body — compile time and HLO size must not grow with
+    # depth) under the measured-winner remat policy, replicated while the
+    # analytic train state fits the documented HBM budget and FSDP over all
+    # local chips once it does not. Each rung records step ms, MFU, compile
+    # wall, unoptimized-HLO size, serving slots/chip at that width (through
+    # the engine's own slots_report accounting — the r07 capacity numbers
+    # stay honest as widths grow), and a COLLECTIVES.json-derived pod-scale
+    # step prediction: the committed fsdp8 inventory's collective
+    # bytes-per-parameter × this rung's parameter count ÷ the 50 GB/s ICI
+    # figure, added to the measured step.
+    from eventstreamgpt_tpu.training import TrainState
+    from eventstreamgpt_tpu.training.sharding import (
+        make_mesh,
+        make_state_shardings,
+        train_state_bytes,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def ladder_config(w: int) -> StructuredTransformerConfig:
+        heads = max(w // 128, WIDE_HEADS)
+        cfg = StructuredTransformerConfig(
+            **{
+                **base_model_kwargs,
+                "hidden_size": w,
+                "head_dim": w // heads,
+                "num_attention_heads": heads,
+                "num_hidden_layers": WIDE_LAYERS,
+                "intermediate_size": 4 * w,
+                "attention_implementation": "pallas_flash",
+                "attention_dropout": 0.0,
+                "gradient_checkpointing": wide_remat_policy,
+                "scan_layers": True,
+            }
+        )
+        cfg.set_to_dataset(train_ds)
+        cfg.max_seq_len = PACKED_SEQ_LEN
+        return cfg
+
+    fsdp_budget = json.loads(
+        (Path(__file__).resolve().parent / "COLLECTIVES.json").read_text()
+    )["layouts"]["fsdp8"]
+    fsdp_bytes_per_param = fsdp_budget["total_bytes"] / max(fsdp_budget["n_params"], 1)
+    ICI_BYTES_PER_S = 50e9  # the COLLECTIVES.json scaling-prediction figure
+
+    ladder_step_ms: dict = {}
+    ladder_mfu: dict = {}
+    ladder_pod_pred_ms: dict = {}
+    ladder_detail: dict = {}
+    ladder_slots: dict = {}
+    width4096_state_gb = float("nan")
+    for w in WIDTH_LADDER:
+        cfg_w = ladder_config(w)
+        model_w = build_model(cfg_w)
+        tx_w, _ = build_optimizer(oc)
+
+        def ladder_init(key, _model=model_w, _tx=tx_w):
+            p = _model.init(key, packed_init)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32), params=p, opt_state=_tx.init(p)
+            )
+
+        shapes = jax.eval_shape(ladder_init, jax.random.PRNGKey(0))
+        n_params_w = sum(
+            int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(shapes.params)
+        )
+        state_gb = train_state_bytes(n_params_w) / 1e9
+        fits_replicated = state_gb <= HBM_HEADROOM * HBM_BUDGET_GB
+        if w == 4096:
+            width4096_state_gb = round(state_gb, 2)
+        ladder_slots[str(w)] = engine.slots_report(
+            hbm_gb=HBM_BUDGET_GB,
+            config=cfg_w,
+            max_len=PACKED_SEQ_LEN,
+            params_bytes=4 * n_params_w,
+        )["per_dtype"]["bf16"]["max_slots"]
+        pred_comm_ms = fsdp_bytes_per_param * n_params_w / ICI_BYTES_PER_S * 1e3
+        detail = {
+            "n_params": n_params_w,
+            "state_gb": round(state_gb, 2),
+            "fits_replicated": fits_replicated,
+        }
+        if fits_replicated:
+            mesh_w, layout = mesh, "replicated"
+        elif n_devices > 1 and PACKED_BATCH % n_devices == 0:
+            mesh_w, layout = make_mesh(1, 1, n_fsdp=n_devices), f"fsdp{n_devices}"
+        else:
+            mesh_w, layout = None, None
+            detail["skipped"] = (
+                f"replicated does not fit {HBM_BUDGET_GB} GB and FSDP needs "
+                f">1 local chips dividing batch {PACKED_BATCH} (n_devices={n_devices})"
+            )
+        detail["layout"] = layout
+        if w == WIDTH_LADDER[0]:
+            # Rung 0 is the remat-A/B probe above — reuse its measurement
+            # (same shape, same policy) instead of a duplicate compile.
+            detail["measured_by"] = "width1024_remat_ab"
+            ladder_step_ms[str(w)] = round(wide_probe_ms, 2)
+            ladder_mfu[str(w)] = round(wide_mfu, 4)
+            ladder_pod_pred_ms[str(w)] = round(wide_probe_ms + pred_comm_ms, 2)
+            ladder_detail[str(w)] = detail
+            continue
+        if mesh_w is None:
+            ladder_step_ms[str(w)] = None
+            ladder_mfu[str(w)] = None
+            ladder_pod_pred_ms[str(w)] = None
+            ladder_detail[str(w)] = detail
+            continue
+        # Materialize the state directly into its layout (out_shardings):
+        # the FSDP rung's replicated tree would not fit one chip at all.
+        if layout == "replicated":
+            sh_w = jax.tree_util.tree_map(
+                lambda _: NamedSharding(mesh_w, P()), shapes
+            )
+        else:
+            sh_w = make_state_shardings(shapes, mesh_w)
+        state_w = jax.jit(ladder_init, out_shardings=sh_w)(jax.random.PRNGKey(0))
+        batch_w = shard_batch(packed_init, mesh_w)
+        step_w = make_train_step(model_w, tx_w)
+        t0 = time.perf_counter()
+        lowered_w = step_w.lower(state_w, batch_w, rng)
+        compiled_w = lowered_w.compile()
+        detail["compile_s"] = round(time.perf_counter() - t0, 1)
+        # HLO-size probe OUTSIDE the timed window: text serialization is
+        # not compile work and would skew the depth/width compile story.
+        detail["hlo_chars"] = len(lowered_w.as_text())
+        state_w, wl = compiled_w(state_w, batch_w, rng)
+        drain(wl)
+        tunnel_probe(f"width{w}", extras)
+        step_ms_w, state_w = _probe_step_ms(
+            compiled_w, state_w, batch_w, rng, extras=extras, name=f"width{w}"
+        )
+        rate_w = packed_probe_events / (step_ms_w / 1000.0) / n_devices
+        ladder_step_ms[str(w)] = round(step_ms_w, 2)
+        ladder_mfu[str(w)] = round(rate_w * 6 * n_params_w / 197e12, 4)
+        ladder_pod_pred_ms[str(w)] = round(step_ms_w + pred_comm_ms, 2)
+        ladder_detail[str(w)] = detail
+        del state_w, batch_w, compiled_w, lowered_w  # release HBM before the next rung
+
+    # ---- scan-over-layers depth flatness (r10 acceptance): compile wall +
+    # unoptimized-HLO size vs depth, scanned vs unrolled, at the padded
+    # bench shape. scan_layers compiles ONE block body, so its d8/d2 ratios
+    # must sit near 1.0 while the unrolled ratios grow with depth.
+    scan_flat_detail: dict = {}
+    for scan_on in (False, True):
+        for depth in (2, 8):
+            cfg_d = StructuredTransformerConfig(
+                **{**base_model_kwargs, "num_hidden_layers": depth, "scan_layers": scan_on}
+            )
+            cfg_d.set_to_dataset(train_ds)
+            model_d = build_model(cfg_d)
+            tx_d, _ = build_optimizer(oc)
+            state_d, _ = fresh_state(model_d, init_batch, tx_d)
+            state_d = replicate(state_d, mesh)
+            step_d = make_train_step(model_d, tx_d)
+            t0 = time.perf_counter()
+            lowered_d = step_d.lower(state_d, resident, rng)
+            lowered_d.compile()
+            compile_s = time.perf_counter() - t0
+            # Serialization excluded from the timed window (see the ladder):
+            # the unrolled d8 text is the largest and would inflate exactly
+            # the ratio this section exists to measure.
+            scan_flat_detail[f"{'scan' if scan_on else 'unrolled'}_d{depth}"] = {
+                "compile_s": round(compile_s, 2),
+                "hlo_chars": len(lowered_d.as_text()),
+            }
+    scan_depth_flat = {
+        key: round(
+            scan_flat_detail[f"{key.split('_')[0]}_d8"][metric]
+            / max(scan_flat_detail[f"{key.split('_')[0]}_d2"][metric], 1e-9),
+            2,
+        )
+        for key, metric in (
+            ("scan_hlo", "hlo_chars"),
+            ("unrolled_hlo", "hlo_chars"),
+            ("scan_compile", "compile_s"),
+            ("unrolled_compile", "compile_s"),
+        )
+    }
+
+    # ---- the ladder's long-context packed-stream ring arm: rung-0 width
+    # with the event axis sharded 2-way over a `context` mesh axis and
+    # attention running as a ring (parallel/ring_attention.py) — the layout
+    # that extends the ladder along sequence length once one chip's HBM
+    # caps the packed row. Needs >= 2 local chips; skipped (reason
+    # recorded) on single-chip topologies.
+    ring_step_ms = None
+    if n_devices >= 2 and PACKED_SEQ_LEN % 2 == 0:
+        from eventstreamgpt_tpu.parallel import ring_context
+        from eventstreamgpt_tpu.training.pretrain import (
+            context_parallel_mesh,
+            shard_batch_cp,
+        )
+
+        ring_cfg = StructuredTransformerConfig.from_dict(
+            {**ladder_config(WIDTH_LADDER[0]).to_dict(), "attention_implementation": "ring"}
+        )
+        ring_model = build_model(ring_cfg)
+        ring_tx, _ = build_optimizer(oc)
+        ring_mesh = context_parallel_mesh(2, PACKED_BATCH)
+        ring_state, _ = fresh_state(ring_model, packed_init, ring_tx)
+        ring_state = replicate(ring_state, ring_mesh)
+        ring_batch = shard_batch_cp(packed_init, ring_mesh)
+        with ring_context(ring_mesh):
+            ring_step = make_train_step(ring_model, ring_tx)
+            ring_state, rloss = ring_step(ring_state, ring_batch, rng)
+            drain(rloss)
+            tunnel_probe("width_ring", extras)
+            ring_step_ms, ring_state = _probe_step_ms(
+                ring_step, ring_state, ring_batch, rng, extras=extras, name="width_ring"
+            )
+        ring_step_ms = round(ring_step_ms, 2)
+        extras["width_ladder_ring_cp"] = 2
+    else:
+        extras["width_ladder_ring_skipped"] = f"needs >=2 local chips (n_devices={n_devices})"
+
     # ---- ETL phase (host-only; independent of the tunnel).
     etl_metrics = run_etl_bench()
 
@@ -1179,15 +1409,50 @@ def main():
                 "zeroshot_subjects": zs_subjects,
                 "zeroshot_num_samples": ZS_SAMPLES,
                 "zeroshot_max_new_events": GEN_NEW,
+                # Width-ladder / scan detail (r10): per-rung accounting +
+                # compile walls, per-depth compile/HLO points, serving
+                # capacity per rung, and the ring arm — the headline tail
+                # below carries only the per-rung step/MFU/prediction dicts.
+                "width_ladder_detail": ladder_detail,
+                "width_ladder_slots_per_chip": ladder_slots,
+                "scan_depth_compile_detail": scan_flat_detail,
+                "width_ladder_ring_step_ms": ring_step_ms,
+                # Detail keys displaced from the tail by the r10 ladder keys
+                # (their headline equivalents remain in the tail block).
+                "width1024_probe_step_ms": round(wide_probe_ms, 2),
+                "width1024_probe_events_per_sec_per_chip": round(wide_probe_rate, 1),
+                "generate_wasted_decode_frac": round(generate_wasted_frac, 4),
+                "engine_p50_latency_ms": round(engine_p50, 1),
+                "service_p50_latency_ms": round(service_p50, 1),
+                "zeroshot_wall_per_subject_ms": round(1000.0 * zs_wall_s / zs_subjects, 2),
+                "zeroshot_vs_generation_rate_ratio": round(
+                    zs_gen_rate / max(gen_events_per_sec, 1e-9), 3
+                ),
+                "na_epoch_rates": [round(r / n_devices, 1) for r, _, _ in na_rates],
+                "packed_epoch_rates": [
+                    round(r / n_devices, 1) for r, _, _ in packed_rates
+                ],
                 # ---- headline block (must stay last: the driver captures
                 # only the final 2000 chars of stdout; per-chip units).
                 # Production-width remat-policy A/B (r06 lever 1): both arms
                 # every run; the measured winner carries the headline MFU.
                 "width1024_remat_ab_ms": {k: round(v, 2) for k, v in width_ab_ms.items()},
                 "width1024_remat_policy": wide_remat_policy,
-                "width1024_probe_step_ms": round(wide_probe_ms, 2),
-                "width1024_probe_events_per_sec_per_chip": round(wide_probe_rate, 1),
                 "width1024_probe_mfu_vs_197tflops": round(wide_mfu, 4),
+                # Width ladder + scan-over-layers headline (r10): per-rung
+                # step ms / MFU (null = rung skipped, reason in
+                # width_ladder_detail), the COLLECTIVES.json-derived
+                # pod-scale step prediction (measured step + committed
+                # fsdp8 collective bytes-per-param × rung params ÷ 50 GB/s
+                # ICI), the 4096 rung's analytic train-state footprint
+                # (> the documented budget ⇒ FSDP-only), and the
+                # depth-flatness verdict (d8/d2 compile + HLO ratios —
+                # scan must sit near 1.0, unrolled grows with depth).
+                "width_ladder_step_ms": ladder_step_ms,
+                "width_ladder_mfu": ladder_mfu,
+                "width_ladder_pod_step_ms_pred": ladder_pod_pred_ms,
+                "fsdp_width4096_state_gb": width4096_state_gb,
+                "scan_depth_flat": scan_depth_flat,
                 # Per-lever NA A/Bs (r06 levers 2 + 3: each arm flips ONE
                 # lever off the production default) + the NA/CI cost ratio
                 # (probe/probe minimums on the same resident batch).
@@ -1215,11 +1480,9 @@ def main():
                 # generate() path.
                 "engine_events_per_sec_per_chip": round(engine_rate, 1),
                 "engine_wasted_decode_frac": eng_stats["wasted_decode_frac"],
-                "generate_wasted_decode_frac": round(generate_wasted_frac, 4),
                 "engine_vs_generate_ratio": round(
                     engine_rate / max(gen_arm_rate, 1e-9), 3
                 ),
-                "engine_p50_latency_ms": round(engine_p50, 1),
                 "engine_p95_latency_ms": round(engine_p95, 1),
                 # r09 lever 2: fused sampling tail (filter+gumbel+argmax+
                 # active-merge in one scope, Pallas on chip) vs the r07
@@ -1246,7 +1509,6 @@ def main():
                 # hiding the boundary readback + disaggregating prefill cut
                 # tail latency vs the synchronous engine arm; per-request
                 # outputs are bit-identical across both arms (tier-1 pin).
-                "service_p50_latency_ms": round(service_p50, 1),
                 "service_p95_latency_ms": round(service_p95, 1),
                 "service_vs_engine_p95_ratio": round(
                     service_p95 / max(engine_p95, 1e-9), 3
@@ -1254,18 +1516,10 @@ def main():
                 "service_reject_frac": svc_stats["reject_frac"],
                 # Zero-shot end-to-end (VERDICT r05 #7): the composed
                 # generate → label → aggregate path on resident prompts.
-                "zeroshot_wall_per_subject_ms": round(1000.0 * zs_wall_s / zs_subjects, 2),
                 "zeroshot_generated_events_per_sec_per_chip": round(zs_gen_rate, 1),
-                "zeroshot_vs_generation_rate_ratio": round(
-                    zs_gen_rate / max(gen_events_per_sec, 1e-9), 3
-                ),
                 "zeroshot_auroc": round(float(zs_auroc), 4),
                 "zeroshot_frac_unpredictable": round(zs_frac_unpredictable, 4),
-                "na_epoch_rates": [round(r / n_devices, 1) for r, _, _ in na_rates],
                 "na_events_per_sec_per_chip": round(na_events_per_sec, 1),
-                "packed_epoch_rates": [
-                    round(r / n_devices, 1) for r, _, _ in packed_rates
-                ],
                 "packed_seq1024_events_per_sec_per_chip": round(packed_events_per_sec, 1),
                 "tuning_loss": round(eval_metrics.get("tuning_loss", float("nan")), 4),
                 "epoch_rates": [round(r / n_devices, 1) for r, _, _ in epoch_rates],

@@ -93,6 +93,8 @@ def canonical_pretrain_step(
     with_health: bool = False,
     na: bool = False,
     na_impl: str | None = None,
+    scan: bool = False,
+    n_fsdp: int = 1,
 ):
     """The production pretrain train step on a ``data×model`` mesh — the
     exact construction ``dryrun_multichip`` audits into ``COLLECTIVES.json``
@@ -108,7 +110,16 @@ def canonical_pretrain_step(
     compiles on the virtual CPU mesh — the TPU production program differs
     only in the kernel's Mosaic body). CI programs compile under
     ``gradient_checkpointing="save_attention"`` (the r06 production-width
-    remat policy), matching the dry run."""
+    remat policy), matching the dry run.
+
+    ``scan`` builds the r10 scan-over-layers variant (``scan_layers=True``:
+    one pattern-period block body scanned over stacked params); ``n_fsdp``
+    > 1 puts an ``fsdp`` axis on the mesh — parameters and Adam moments
+    shard their largest dimension over it, the batch shards over
+    ``(data, fsdp)`` jointly, and GSPMD's gather-on-use /
+    reduce-scatter-on-grad schedule lands in the collective inventory
+    (the ``fsdp8`` budget — the one layout whose bytes are all-gather +
+    reduce-scatter dominated by design)."""
     import jax
     import jax.numpy as jnp
 
@@ -117,19 +128,25 @@ def canonical_pretrain_step(
     from ..training.sharding import make_mesh, shard_state
 
     ge = _graft_entry()
-    _require_devices(n_data * n_model)
-    mesh = make_mesh(n_data, n_model)
+    _require_devices(n_data * n_model * n_fsdp)
+    mesh = make_mesh(n_data, n_model, n_fsdp=n_fsdp)
+    overrides = {"scan_layers": True} if scan else {}
     if na:
-        overrides = {"dep_graph_attention_impl": na_impl} if na_impl else {}
-        model, batch = ge._make_model_and_batch(batch_size=2 * n_data, na=True, **overrides)
+        if na_impl:
+            overrides["dep_graph_attention_impl"] = na_impl
+        model, batch = ge._make_model_and_batch(
+            batch_size=2 * n_data * n_fsdp, na=True, **overrides
+        )
     else:
         model, batch = ge._make_model_and_batch(
-            batch_size=2 * n_data, gradient_checkpointing="save_attention"
+            batch_size=2 * n_data * n_fsdp,
+            gradient_checkpointing="save_attention",
+            **overrides,
         )
     params = model.init(jax.random.PRNGKey(0), batch)
     oc = OptimizationConfig(
         init_lr=1e-3,
-        batch_size=2 * n_data,
+        batch_size=2 * n_data * n_fsdp,
         max_training_steps=10,
         lr_num_warmup_steps=1,
         lr_frac_warmup_steps=None,
@@ -449,6 +466,15 @@ def run_program_checks(
     programs["pretrain:na_pallas_dp8"] = canonical_pretrain_step(
         8, 1, na=True, na_impl="pallas_interpret"
     )
+    # The r10 scale-up programs: the scan-over-layers step on the pure-dp
+    # mesh (stacked params, one scanned body — its budget differs from dp8
+    # only in gradient-sweep *shape*, not magnitude) and the FSDP step
+    # (scan + parameter/optimizer sharding over an 8-way fsdp axis — the
+    # one layout whose budget is all-gather/reduce-scatter dominated; an
+    # accidental re-replication or a per-step full-state gather is a byte
+    # blowup here long before it is an HBM OOM at width 4096).
+    programs["pretrain:scan_dp8"] = canonical_pretrain_step(8, 1, scan=True)
+    programs["pretrain:fsdp8"] = canonical_pretrain_step(1, 1, scan=True, n_fsdp=8)
     programs["finetune:dp8"] = canonical_finetune_step(8)
     programs["finetune:dp8_health"] = canonical_finetune_step(8, with_health=True)
     programs["generation:ci"] = canonical_generation_program()
@@ -489,6 +515,8 @@ def run_program_checks(
         # has its own committed budget (na_dp8).
         budget_keys = {f"pretrain:{name}": name for name in layouts}
         budget_keys["pretrain:dp8_health"] = "dp8"
+        budget_keys["pretrain:scan_dp8"] = "scan_dp8"
+        budget_keys["pretrain:fsdp8"] = "fsdp8"
         budget_keys["pretrain:na_dp8"] = "na_dp8"
         budget_keys["pretrain:na_pallas_dp8"] = "na_pallas_dp8"
         budget_keys["engine:decode"] = "engine_dp8"

@@ -203,6 +203,7 @@ class StructuredTransformerConfig(JSONableMixin):
         seq_window_size: int = 32,
         attention_implementation: str = "einsum",
         gradient_checkpointing: str = "none",
+        scan_layers: bool = False,
         precision: str = "fp32",
         dep_graph_attention_types: ATTENTION_TYPES_LIST_T | None = None,
         dep_graph_window_size: int | None = 2,
@@ -469,6 +470,16 @@ class StructuredTransformerConfig(JSONableMixin):
                 f"'dots_no_batch', 'save_attention'; got {gradient_checkpointing}"
             )
         self.gradient_checkpointing = gradient_checkpointing
+        # Depth as a first-class scaling axis (r10 scale-up round): compile
+        # ONE layer body regardless of num_hidden_layers by running the
+        # encoder stack as ``nn.scan`` over the (remat-wrapped) block with
+        # stacked ``(L/p, ...)`` parameters, where p is the attention-type
+        # pattern period (models/transformer.py `scan_period`). False keeps
+        # the historical unrolled loop — the parity reference whose
+        # loss/grads the scanned path must reproduce (tests/models/
+        # test_scan_layers.py); checkpoints migrate between the two layouts
+        # with `models.transformer.stack_layer_params` / `unstack_layer_params`.
+        self.scan_layers = bool(scan_layers)
         if precision not in ("fp32", "bf16"):
             raise ValueError(f"precision must be 'fp32' or 'bf16'; got {precision}")
         self.precision = precision

@@ -20,6 +20,7 @@ inside ``lax.scan``/``while_loop``.
 from __future__ import annotations
 
 import math
+import re
 from typing import Any, Optional
 
 import flax.linen as nn
@@ -867,6 +868,28 @@ class ConditionallyIndependentPointProcessInputLayer(nn.Module):
         return nn.Dropout(rate=float(cfg.input_dropout))(embed, deterministic=not self.has_rng("dropout"))
 
 
+_NO_REMAT = object()
+
+
+def _remat_policy(config: StructuredTransformerConfig, use_flag: bool = False):
+    """Resolves ``config.gradient_checkpointing`` into a jax.checkpoint
+    policy, ``None`` for whole-block remat, or the `_NO_REMAT` sentinel."""
+    mode = getattr(config, "gradient_checkpointing", "none")
+    if use_flag and mode == "none":
+        mode = "block"
+    if mode == "none":
+        return _NO_REMAT
+    return {
+        "block": None,
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        "save_attention": jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            jax.checkpoint_policies.save_only_these_names(ATTENTION_CHECKPOINT_NAME),
+        ),
+    }[mode]
+
+
 def remat_block_cls(config: StructuredTransformerConfig, use_flag: bool = False):
     """`InnerBlock`, wrapped per the configured rematerialization policy.
 
@@ -883,23 +906,256 @@ def remat_block_cls(config: StructuredTransformerConfig, use_flag: bool = False)
     ``dots_no_batch`` pays at production width). The legacy
     ``use_gradient_checkpointing`` bool maps to ``"block"``.
     """
-    mode = getattr(config, "gradient_checkpointing", "none")
-    if use_flag and mode == "none":
-        mode = "block"
-    if mode == "none":
+    policy = _remat_policy(config, use_flag)
+    if policy is _NO_REMAT:
         return InnerBlock
-    policy = {
-        "block": None,
-        "dots": jax.checkpoint_policies.checkpoint_dots,
-        "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-        "save_attention": jax.checkpoint_policies.save_from_both_policies(
-            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            jax.checkpoint_policies.save_only_these_names(ATTENTION_CHECKPOINT_NAME),
-        ),
-    }[mode]
     # Args seen by the lifted transform: (module, hidden, attn_mask,
     # layer_past, use_cache, output_attentions, static_kv_first).
     return nn.remat(InnerBlock, static_argnums=(4, 5, 6), policy=policy)
+
+
+# ------------------------------------------------------- scan-over-layers
+def scan_period(config: StructuredTransformerConfig) -> tuple[int, int]:
+    """``(period, n_groups)`` of the attention-type pattern under scan.
+
+    ``nn.scan`` requires every scan step to trace the identical program, but
+    the per-layer attention types (``seq_attention_layers``, and for NA
+    models ``dep_graph_attention_layers``) may alternate — the default stack
+    is ``["local", "global"]`` repeated. The scan body therefore unrolls one
+    *pattern period* (the smallest ``p`` dividing ``num_hidden_layers`` such
+    that every attention-type list is ``p``-periodic) and the scan runs over
+    ``num_hidden_layers / p`` stacked parameter groups. Uniform stacks give
+    ``p == 1`` (a true per-layer scan); an aperiodic hand-written list
+    degenerates to ``p == L`` (one group — correct, but compiling every
+    layer, i.e. no better than unrolled).
+    """
+    L = config.num_hidden_layers
+    lists = [config.seq_attention_layers]
+    if getattr(config, "dep_graph_attention_layers", None) is not None:
+        lists.append(config.dep_graph_attention_layers)
+    for p in range(1, L + 1):
+        if L % p != 0:
+            continue
+        if all(lst[i] == lst[i % p] for lst in lists for i in range(L)):
+            return p, L // p
+    return L, 1
+
+
+def _stack_trees(trees):
+    """Stacks a list of like-structured pytrees along a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *trees)
+
+
+def _unstack_tree(tree, n: int):
+    """Splits a stacked pytree back into ``n`` per-layer pytrees."""
+    return [jax.tree_util.tree_map(lambda x: x[g], tree) for g in range(n)]
+
+
+class _CIScanBody(nn.Module):
+    """One scan step of the CI encoder: a pattern period of `InnerBlock`s.
+
+    ``layer_id`` within the body indexes the pattern position (0..period-1);
+    periodicity (`scan_period`) guarantees ``seq_attention_layers[g*p + j]
+    == seq_attention_layers[j]`` for every group ``g``, so the one traced
+    body is exact for all of them. Per-layer KV caches ride the scan as
+    stacked inputs (``xs``) and the updated caches return as stacked
+    outputs, keeping the `KVCache`-tuple interface of the unrolled path at
+    the module boundary.
+    """
+
+    config: StructuredTransformerConfig
+    period: int
+    use_cache: bool = False
+    output_hidden_states: bool = False
+
+    @nn.compact
+    def __call__(self, hidden_states, xs, attention_mask, segment_ids, event_mask):
+        presents = []
+        hiddens = []
+        for j in range(self.period):
+            if self.output_hidden_states:
+                hiddens.append(hidden_states)
+            block = InnerBlock(self.config, layer_id=j, is_seq=True, name=f"b{j}")
+            hidden_states, outputs = block(
+                hidden_states,
+                attention_mask,
+                xs[j] if xs is not None else None,
+                self.use_cache,
+                False,
+                False,
+                segment_ids,
+            )
+            if event_mask is not None:
+                hidden_states = jnp.where(event_mask[..., None], hidden_states, 0.0)
+            if self.use_cache:
+                presents.append(outputs.get("present_key_value"))
+        ys = (
+            tuple(presents) if self.use_cache else None,
+            tuple(hiddens) if self.output_hidden_states else None,
+        )
+        return hidden_states, ys
+
+
+class _NAScanBody(nn.Module):
+    """One scan step of the NA encoder: a pattern period of
+    `StructuredTransformerBlock`s, with the two-level cache plumbing (seq +
+    dep-graph `KVCache`s per layer) threaded through the scan as stacked
+    inputs/outputs. The cache-mode flags are static attributes — they are
+    uniform across layers by the NA state machine's construction."""
+
+    config: StructuredTransformerConfig
+    period: int
+    update_seq_cache: bool = False
+    update_dep_graph_cache: bool = False
+    prepend_graph_with_history_embeddings: bool = True
+    update_last_graph_el_to_history_embedding: bool = True
+    output_hidden_states: bool = False
+
+    @nn.compact
+    def __call__(self, hidden_states, xs, seq_attention_mask, event_mask, segment_ids):
+        seq_xs, dep_xs = xs if xs is not None else (None, None)
+        presents_seq, presents_dep, hiddens = [], [], []
+        for j in range(self.period):
+            if self.output_hidden_states:
+                hiddens.append(hidden_states)
+            block = StructuredTransformerBlock(self.config, layer_id=j, name=f"b{j}")
+            hidden_states, extra = block(
+                hidden_states,
+                seq_attention_mask=seq_attention_mask,
+                event_mask=event_mask,
+                segment_ids=segment_ids,
+                prepend_graph_with_history_embeddings=self.prepend_graph_with_history_embeddings,
+                update_last_graph_el_to_history_embedding=self.update_last_graph_el_to_history_embedding,
+                seq_module_kwargs=dict(
+                    layer_past=seq_xs[j] if seq_xs is not None else None,
+                    use_cache=self.update_seq_cache,
+                    output_attentions=False,
+                ),
+                dep_graph_module_kwargs=dict(
+                    layer_past=dep_xs[j] if dep_xs is not None else None,
+                    use_cache=self.update_dep_graph_cache,
+                    output_attentions=False,
+                ),
+            )
+            if self.update_seq_cache:
+                presents_seq.append(extra["seq_module"]["present_key_value"])
+            if self.update_dep_graph_cache:
+                presents_dep.append(extra["dep_graph_module"]["present_key_value"])
+        ys = (
+            tuple(presents_seq) if self.update_seq_cache else None,
+            tuple(presents_dep) if self.update_dep_graph_cache else None,
+            tuple(hiddens) if self.output_hidden_states else None,
+        )
+        return hidden_states, ys
+
+
+def _scan_stack_cls(body_cls, config, use_flag: bool, n_groups: int):
+    """``nn.scan`` over the (optionally remat-wrapped) scan body.
+
+    Composes per-layer rematerialization with the scan exactly as the
+    pjit/TPUv4 playbook prescribes: the remat policy (including r06's
+    ``save_attention``) applies to ONE body, and the scan stacks it
+    ``n_groups`` deep with ``variable_axes={"params": 0}`` — so HLO size and
+    compile time are depth-independent. ``prevent_cse=False`` is safe (and
+    measurably faster) under scan: the loop boundary already prevents the
+    cross-iteration CSE that standalone remat must guard against.
+    """
+    policy = _remat_policy(config, use_flag)
+    if policy is not _NO_REMAT:
+        body_cls = nn.remat(body_cls, policy=policy, prevent_cse=False)
+    return nn.scan(
+        body_cls,
+        variable_axes={"params": 0},
+        split_rngs={"params": True, "dropout": True},
+        in_axes=(0, nn.broadcast, nn.broadcast, nn.broadcast),
+        out_axes=0,
+        length=n_groups,
+    )
+
+
+def _group_layer_trees(per_layer, period: int, n_groups: int):
+    """``[layer0, layer1, ...]`` → per-pattern-position stacked trees:
+    ``tuple_j(stack_g(per_layer[g*period + j]))`` — the xs layout the scan
+    bodies consume."""
+    return tuple(
+        _stack_trees([per_layer[g * period + j] for g in range(n_groups)])
+        for j in range(period)
+    )
+
+
+def _ungroup_layer_trees(ys, period: int, n_groups: int) -> list:
+    """Inverse of `_group_layer_trees` for stacked scan outputs."""
+    per_position = [_unstack_tree(ys[j], n_groups) for j in range(period)]
+    return [per_position[j][g] for g in range(n_groups) for j in range(period)]
+
+
+_LAYER_KEY_RE = re.compile(r"^h(\d+)$")
+
+
+def _is_layer_dict(node, num_layers: int) -> bool:
+    from collections.abc import Mapping
+
+    if not isinstance(node, Mapping):
+        return False
+    return all(f"h{i}" in node for i in range(num_layers))
+
+
+def stack_layer_params(params, config: StructuredTransformerConfig):
+    """Migrates an **unrolled** parameter tree to the **scanned** layout.
+
+    Wherever a subtree holds the per-layer scopes ``h0..h{L-1}`` (the CI and
+    NA encoders, and every model wrapping them), they are replaced by one
+    ``h_scan`` scope whose pattern-position children ``b0..b{p-1}`` hold the
+    layer parameters stacked ``(L/p, ...)`` along a new leading axis — the
+    exact tree `scan_layers=True` initializes, so an unrolled checkpoint
+    restores into a scanned model (and vice versa via
+    `unstack_layer_params`). Pure relayout: values are bit-identical.
+    """
+    from collections.abc import Mapping
+
+    L = config.num_hidden_layers
+    p, G = scan_period(config)
+
+    def walk(node):
+        if not isinstance(node, Mapping):
+            return node
+        if _is_layer_dict(node, L):
+            out = {
+                k: walk(v) for k, v in node.items() if not _LAYER_KEY_RE.match(str(k))
+            }
+            out["h_scan"] = {
+                f"b{j}": _stack_trees([node[f"h{g * p + j}"] for g in range(G)])
+                for j in range(p)
+            }
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(params)
+
+
+def unstack_layer_params(params, config: StructuredTransformerConfig):
+    """Migrates a **scanned** parameter tree back to the **unrolled** layout
+    (`stack_layer_params`' inverse) — e.g. to serve a scan-trained
+    checkpoint through a deployment that keeps the unrolled decode program.
+    """
+    from collections.abc import Mapping
+
+    L = config.num_hidden_layers
+    p, G = scan_period(config)
+
+    def walk(node):
+        if not isinstance(node, Mapping):
+            return node
+        if "h_scan" in node and isinstance(node["h_scan"], Mapping):
+            out = {k: walk(v) for k, v in node.items() if k != "h_scan"}
+            groups = node["h_scan"]
+            for j in range(p):
+                for g, tree in enumerate(_unstack_tree(groups[f"b{j}"], G)):
+                    out[f"h{g * p + j}"] = tree
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(params)
 
 
 class ConditionallyIndependentPointProcessTransformer(nn.Module):
@@ -935,30 +1191,65 @@ class ConditionallyIndependentPointProcessTransformer(nn.Module):
         all_attentions = [] if output_attentions else None
         all_hidden = [] if output_hidden_states else None
 
-        block_cls = remat_block_cls(cfg, self.use_gradient_checkpointing)
-
-        for i in range(cfg.num_hidden_layers):
-            if all_hidden is not None:
-                all_hidden.append(hidden_states)
-            layer_past = past[i] if past is not None else None
-            block = block_cls(cfg, layer_id=i, is_seq=True, name=f"h{i}")
-            hidden_states, outputs = block(
-                hidden_states,
-                attention_mask,
-                layer_past,
-                use_cache,
-                output_attentions,
-                False,
-                batch.segment_ids if batch is not None else None,
+        if getattr(cfg, "scan_layers", False):
+            # Depth-independent compilation (r10): ONE pattern-period body is
+            # traced and scanned over stacked (L/p, ...) parameters; per-layer
+            # KV caches thread through as stacked scan inputs/outputs so the
+            # cached decode paths keep the tuple-of-`KVCache` interface.
+            if output_attentions:
+                raise NotImplementedError(
+                    "scan_layers=True does not support output_attentions; migrate "
+                    "the checkpoint to the unrolled layout (unstack_layer_params) "
+                    "for attention introspection."
+                )
+            p, n_groups = scan_period(cfg)
+            xs = _group_layer_trees(list(past), p, n_groups) if past is not None else None
+            event_mask = batch.event_mask if batch is not None else None
+            stack = _scan_stack_cls(
+                _CIScanBody, cfg, self.use_gradient_checkpointing, n_groups
+            )(
+                cfg,
+                period=p,
+                use_cache=use_cache,
+                output_hidden_states=output_hidden_states,
+                name="h_scan",
             )
-            # Reference parity: zero masked events' hidden states between
-            # layers (``transformer.py:820-825``).
-            if batch is not None and batch.event_mask is not None:
-                hidden_states = jnp.where(batch.event_mask[..., None], hidden_states, 0.0)
+            hidden_states, (present_ys, hidden_ys) = stack(
+                hidden_states,
+                xs,
+                attention_mask,
+                batch.segment_ids if batch is not None else None,
+                event_mask,
+            )
             if presents is not None:
-                presents.append(outputs.get("present_key_value"))
-            if all_attentions is not None:
-                all_attentions.append(outputs.get("attn_weights"))
+                presents = _ungroup_layer_trees(present_ys, p, n_groups)
+            if all_hidden is not None:
+                all_hidden = _ungroup_layer_trees(hidden_ys, p, n_groups)
+        else:
+            block_cls = remat_block_cls(cfg, self.use_gradient_checkpointing)
+
+            for i in range(cfg.num_hidden_layers):
+                if all_hidden is not None:
+                    all_hidden.append(hidden_states)
+                layer_past = past[i] if past is not None else None
+                block = block_cls(cfg, layer_id=i, is_seq=True, name=f"h{i}")
+                hidden_states, outputs = block(
+                    hidden_states,
+                    attention_mask,
+                    layer_past,
+                    use_cache,
+                    output_attentions,
+                    False,
+                    batch.segment_ids if batch is not None else None,
+                )
+                # Reference parity: zero masked events' hidden states between
+                # layers (``transformer.py:820-825``).
+                if batch is not None and batch.event_mask is not None:
+                    hidden_states = jnp.where(batch.event_mask[..., None], hidden_states, 0.0)
+                if presents is not None:
+                    presents.append(outputs.get("present_key_value"))
+                if all_attentions is not None:
+                    all_attentions.append(outputs.get("attn_weights"))
 
         hidden_states = nn.LayerNorm(
             epsilon=cfg.layer_norm_epsilon, dtype=cfg.compute_dtype, name="ln_f"
@@ -1160,39 +1451,85 @@ class NestedAttentionPointProcessTransformer(nn.Module):
         all_attentions = {"seq_attentions": [], "dep_graph_attentions": []} if output_attentions else None
         all_hidden = [] if output_hidden_states else None
 
-        for i in range(cfg.num_hidden_layers):
-            if all_hidden is not None:
-                all_hidden.append(hidden_states)
-            block = StructuredTransformerBlock(cfg, layer_id=i, name=f"h{i}")
-            hidden_states, extra = block(
-                hidden_states,
-                seq_attention_mask=seq_attention_mask,
-                event_mask=event_mask,
-                segment_ids=segment_ids,
+        if getattr(cfg, "scan_layers", False):
+            # The NA stack scans like the CI stack (one pattern-period body,
+            # stacked params), with BOTH cache levels — the per-layer seq
+            # caches and the per-event dep-graph caches — threaded through
+            # the scan as stacked inputs/outputs. The cache-mode flags are
+            # uniform across layers (the state machine above), so the body
+            # is identical for every scan step.
+            if output_attentions:
+                raise NotImplementedError(
+                    "scan_layers=True does not support output_attentions; migrate "
+                    "the checkpoint to the unrolled layout (unstack_layer_params) "
+                    "for attention introspection."
+                )
+            p, n_groups = scan_period(cfg)
+            xs = None
+            if seq_past is not None or dep_graph_past is not None:
+                xs = (
+                    _group_layer_trees(list(seq_past), p, n_groups)
+                    if seq_past is not None
+                    else None,
+                    _group_layer_trees(list(dep_graph_past), p, n_groups)
+                    if dep_graph_past is not None
+                    else None,
+                )
+            stack = _scan_stack_cls(
+                _NAScanBody, cfg, self.use_gradient_checkpointing, n_groups
+            )(
+                cfg,
+                period=p,
+                update_seq_cache=update_seq_cache,
+                update_dep_graph_cache=update_dep_graph_cache,
                 prepend_graph_with_history_embeddings=prepend_graph_with_history_embeddings,
                 update_last_graph_el_to_history_embedding=update_last_graph_el_to_history_embedding,
-                seq_module_kwargs=dict(
-                    layer_past=seq_past[i] if seq_past is not None else None,
-                    use_cache=update_seq_cache,
-                    output_attentions=output_attentions,
-                ),
-                dep_graph_module_kwargs=dict(
-                    layer_past=dep_graph_past[i] if dep_graph_past is not None else None,
-                    use_cache=update_dep_graph_cache,
-                    output_attentions=output_attentions,
-                ),
+                output_hidden_states=output_hidden_states,
+                name="h_scan",
             )
-
+            hidden_states, (seq_ys, dep_ys, hidden_ys) = stack(
+                hidden_states, xs, seq_attention_mask, event_mask, segment_ids
+            )
             if update_seq_cache:
-                presents_seq.append(extra["seq_module"]["present_key_value"])
+                presents_seq = _ungroup_layer_trees(seq_ys, p, n_groups)
             if update_dep_graph_cache:
-                presents_dep.append(extra["dep_graph_module"]["present_key_value"])
-            if output_attentions:
-                if extra["seq_module"] is not None:
-                    all_attentions["seq_attentions"].append(extra["seq_module"].get("attn_weights"))
-                all_attentions["dep_graph_attentions"].append(
-                    extra["dep_graph_module"].get("attn_weights")
+                presents_dep = _ungroup_layer_trees(dep_ys, p, n_groups)
+            if all_hidden is not None:
+                all_hidden = _ungroup_layer_trees(hidden_ys, p, n_groups)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                if all_hidden is not None:
+                    all_hidden.append(hidden_states)
+                block = StructuredTransformerBlock(cfg, layer_id=i, name=f"h{i}")
+                hidden_states, extra = block(
+                    hidden_states,
+                    seq_attention_mask=seq_attention_mask,
+                    event_mask=event_mask,
+                    segment_ids=segment_ids,
+                    prepend_graph_with_history_embeddings=prepend_graph_with_history_embeddings,
+                    update_last_graph_el_to_history_embedding=update_last_graph_el_to_history_embedding,
+                    seq_module_kwargs=dict(
+                        layer_past=seq_past[i] if seq_past is not None else None,
+                        use_cache=update_seq_cache,
+                        output_attentions=output_attentions,
+                    ),
+                    dep_graph_module_kwargs=dict(
+                        layer_past=dep_graph_past[i] if dep_graph_past is not None else None,
+                        use_cache=update_dep_graph_cache,
+                        output_attentions=output_attentions,
+                    ),
                 )
+
+                if update_seq_cache:
+                    presents_seq.append(extra["seq_module"]["present_key_value"])
+                if update_dep_graph_cache:
+                    presents_dep.append(extra["dep_graph_module"]["present_key_value"])
+                if output_attentions:
+                    if extra["seq_module"] is not None:
+                        all_attentions["seq_attentions"].append(extra["seq_module"].get("attn_weights"))
+                    all_attentions["dep_graph_attentions"].append(
+                        extra["dep_graph_module"].get("attn_weights")
+                    )
 
         hidden_states = nn.LayerNorm(
             epsilon=cfg.layer_norm_epsilon, dtype=cfg.compute_dtype, name="ln_f"

@@ -1090,7 +1090,13 @@ class GenerationEngine:
         )
 
     # ---------------------------------------------------------- accounting
-    def slots_report(self, hbm_gb: float = 16.0) -> dict:
+    def slots_report(
+        self,
+        hbm_gb: float = 16.0,
+        config=None,
+        max_len: int | None = None,
+        params_bytes: int | None = None,
+    ) -> dict:
         """Per-cache-dtype HBM capacity accounting (no allocation).
 
         For each supported cache dtype (`ops.kv_quant.CACHE_DTYPES`):
@@ -1100,6 +1106,16 @@ class GenerationEngine:
         replicated parameters and the per-slot content rows. The active
         dtype and its slot-capacity ratio vs bf16 head the report — the
         bench surfaces the ratio as ``kvq_slots_per_chip_ratio``.
+
+        ``config`` / ``max_len`` / ``params_bytes`` override the engine's
+        own geometry so capacity stays honest at widths this engine was not
+        built at: the bench width ladder reports slots/chip for each ladder
+        config (hidden 1024 → 4096) through the SAME accounting instead of
+        extrapolating from the probe shape (r10 satellite). The per-slot
+        content-row term is measured from THIS engine's state and re-scaled
+        by the ``max_len`` ratio (content rows grow with sequence capacity,
+        not hidden width) — an estimate, but one that errs alongside the
+        dominant KV term instead of ignoring the override.
         """
         from ..ops.kv_quant import (
             CACHE_DTYPES,
@@ -1107,7 +1123,8 @@ class GenerationEngine:
             kv_cache_bytes_per_slot,
         )
 
-        cfg = self.config
+        cfg = config if config is not None else self.config
+        max_len = max_len if max_len is not None else self.max_len
         # Non-cache per-slot state: the content rows + cursors (and the NA
         # dep-graph caches, which stay in the compute dtype by design).
         state_bytes = sum(
@@ -1120,9 +1137,12 @@ class GenerationEngine:
             x.nbytes for x in jax.tree_util.tree_leaves(seq_caches)
         )
         row_bytes = max((state_bytes - seq_cache_bytes) // self.n_slots, 1)
-        params_bytes = sum(
-            x.nbytes for x in jax.tree_util.tree_leaves(self.params)
-        )
+        if max_len != self.max_len:
+            row_bytes = max(int(row_bytes * max_len / self.max_len), 1)
+        if params_bytes is None:
+            params_bytes = sum(
+                x.nbytes for x in jax.tree_util.tree_leaves(self.params)
+            )
         budget = max(int(hbm_gb * 1e9) - params_bytes, 0)
 
         per_dtype = {}
@@ -1130,7 +1150,7 @@ class GenerationEngine:
             kv_bytes = kv_cache_bytes_per_slot(
                 cfg.num_hidden_layers,
                 cfg.num_attention_heads,
-                self.max_len,
+                max_len,
                 cfg.head_dim,
                 name,
                 cfg.compute_dtype,

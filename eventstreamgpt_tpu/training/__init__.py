@@ -13,7 +13,15 @@ from .fine_tuning import (
 from .fine_tuning import train as finetune
 from .generative_metrics import GenerativeMetrics
 from .optimizer import build_optimizer, polynomial_decay_with_warmup
-from .sharding import make_mesh, make_param_shardings, shard_params, shard_state
+from .sharding import (
+    batch_partition_axes,
+    make_mesh,
+    make_param_shardings,
+    make_state_shardings,
+    shard_params,
+    shard_state,
+    train_state_bytes,
+)
 from .pretrain import (
     PretrainConfig,
     TrainState,
@@ -38,6 +46,7 @@ __all__ = [
     "init_from_pretrained_encoder",
     "TrainCheckpointManager",
     "TrainState",
+    "batch_partition_axes",
     "build_model",
     "build_optimizer",
     "data_parallel_mesh",
@@ -47,6 +56,7 @@ __all__ = [
     "make_eval_step",
     "make_mesh",
     "make_param_shardings",
+    "make_state_shardings",
     "make_train_step",
     "parallel_mesh",
     "polynomial_decay_with_warmup",
@@ -56,4 +66,5 @@ __all__ = [
     "save_pretrained",
     "shard_batch",
     "train",
+    "train_state_bytes",
 ]

@@ -83,40 +83,56 @@ def build_model(config: StructuredTransformerConfig):
 
 
 # ------------------------------------------------------------------ sharding
-def _fit_data_axis(n_data: int, *batch_sizes: int) -> int:
-    """Largest data-axis size ≤ ``n_data`` that divides every batch size.
+def _fit_data_axis(n_data: int, *batch_sizes: int, multiplier: int = 1) -> int:
+    """Largest data-axis size ≤ ``n_data`` such that ``n_data·multiplier``
+    divides every batch size.
 
     The shared fallback rule of every mesh builder: shrink the data axis
     (rather than fail) so e.g. a batch of 6 on 4 chips runs 2-way
-    data-parallel.
+    data-parallel. ``multiplier`` is the batch-sharding factor the other
+    axes contribute (the ``fsdp`` axis shards the batch too).
     """
-    while n_data > 1 and any(bs % n_data != 0 for bs in batch_sizes):
+    while n_data > 1 and any(bs % (n_data * multiplier) != 0 for bs in batch_sizes):
         n_data -= 1
     return max(n_data, 1)
 
 
-def parallel_mesh(*batch_sizes: int, n_cp: int = 1, n_tp: int = 1) -> Mesh:
-    """The training mesh for any ``data × context × model`` layout.
+def parallel_mesh(*batch_sizes: int, n_cp: int = 1, n_tp: int = 1, n_fsdp: int = 1) -> Mesh:
+    """The training mesh for any ``data × fsdp × context × model`` layout.
 
     Axes of size 1 are omitted, so the degenerate layouts collapse to the
-    1-D ``data`` mesh, ``data × model`` (tensor parallel), or
-    ``data × context`` (ring attention). Axis order puts ``model`` innermost
-    (the highest-bandwidth links carry the per-layer TP all-reduces),
-    ``context`` next (ring kv rotations), ``data`` outermost. The data axis
-    shrinks until it divides every batch size (`_fit_data_axis`).
+    1-D ``data`` mesh, ``data × model`` (tensor parallel), ``data × context``
+    (ring attention), or ``data × fsdp`` (sharded parameters/optimizer —
+    training/sharding.py). Axis order puts ``model`` innermost (the
+    highest-bandwidth links carry the per-layer TP all-reduces), ``context``
+    next (ring kv rotations), ``fsdp`` next (per-layer weight all-gathers /
+    gradient reduce-scatters), ``data`` outermost. The data axis shrinks
+    until ``data × fsdp`` divides every batch size (`_fit_data_axis` — the
+    batch shards over both axes jointly).
     """
     devices = jax.devices()
     n_devices = len(devices)
-    per_data = n_cp * n_tp
+    if n_fsdp > 1 and n_cp > 1:
+        raise ValueError(
+            "fsdp_shards and context_parallel_shards cannot be combined (the "
+            "batch's event axis and the parameter shards would contend for the "
+            "same links); pick one of the two memory axes."
+        )
+    per_data = n_cp * n_tp * n_fsdp
     if n_devices % per_data != 0:
         raise ValueError(
-            f"context_parallel_shards x tensor_parallel_shards ({n_cp}x{n_tp}) must "
+            f"fsdp x context x tensor parallel shards ({n_fsdp}x{n_cp}x{n_tp}) must "
             f"divide the device count ({n_devices}); a silent partial mesh would "
             "waste devices."
         )
-    n_data = _fit_data_axis(n_devices // per_data, *batch_sizes)
+    if n_fsdp > 1 and any(bs % n_fsdp != 0 for bs in batch_sizes):
+        raise ValueError(
+            f"every batch size {batch_sizes} must divide by fsdp_shards ({n_fsdp}): "
+            "the batch shards over the fsdp axis jointly with data."
+        )
+    n_data = _fit_data_axis(n_devices // per_data, *batch_sizes, multiplier=n_fsdp)
     # The pure data-parallel shrink is documented quiet fallback behavior
-    # (data_parallel_mesh); only explicitly-requested TP/CP layouts warn
+    # (data_parallel_mesh); only explicitly-requested TP/CP/FSDP layouts warn
     # about wasted devices.
     if per_data > 1 and n_data * per_data < n_devices:
         print(
@@ -124,6 +140,8 @@ def parallel_mesh(*batch_sizes: int, n_cp: int = 1, n_tp: int = 1) -> Mesh:
             f"using {n_data * per_data} of {n_devices} devices."
         )
     dims = [("data", n_data)]
+    if n_fsdp > 1:
+        dims.append(("fsdp", n_fsdp))
     if n_cp > 1:
         dims.append(("context", n_cp))
     if n_tp > 1:
@@ -145,10 +163,17 @@ def data_parallel_mesh(*batch_sizes: int) -> Mesh:
 
 
 def shard_batch(batch: EventStreamBatch, mesh: Mesh) -> EventStreamBatch:
-    """Device-puts a host batch sharded over the mesh's ``data`` axis."""
+    """Device-puts a host batch sharded over the mesh's batch axes —
+    ``data``, joined by ``fsdp`` when that axis exists (FSDP is data
+    parallelism with sharded parameters, so the batch splits over both)."""
+    from .sharding import batch_partition_axes
+
+    axes = batch_partition_axes(mesh)
+    dim0 = axes if len(axes) > 1 else axes[0]
+
     def put(x):
         x = np.asarray(x)
-        sharding = NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+        sharding = NamedSharding(mesh, P(dim0, *([None] * (x.ndim - 1))))
         return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(put, batch)
@@ -495,6 +520,15 @@ def train(
     # remaining devices data-parallel. The data axis shrinks until it divides
     # both batch sizes, mirroring data_parallel_mesh's fallback.
     n_tp = int(tc.get("tensor_parallel_shards") or 1)
+    # Optional FSDP (r10 scale-up round): trainer_config.fsdp_shards > 1
+    # carves an ``fsdp`` mesh axis; every parameter and its Adam moments
+    # shard their largest divisible dimension over it and the batch shards
+    # over (data, fsdp) jointly, so GSPMD inserts gather-on-use /
+    # reduce-scatter-on-grad — the layout that fits widths the replicated
+    # path cannot (training/sharding.py, docs/scaling.md).
+    # trainer_config.strict_sharding upgrades the replicated-fallback
+    # warning to an error when most parameter bytes miss the rules.
+    n_fsdp = int(tc.get("fsdp_shards") or 1)
     # Optional sequence (context) parallelism: packed long-context batches
     # shard their event axis over a ``context`` mesh axis and attention runs
     # as a ring (parallel/ring_attention.py). Requires packed batches and the
@@ -577,11 +611,14 @@ def train(
     # sequence parallelism; all three composed when both shard counts are set
     # (the axes are orthogonal — each model shard rings its local heads' kv
     # blocks over ``context``; parallel/ring_attention.py ``head_axis``).
-    mesh = parallel_mesh(oc.batch_size, oc.validation_batch_size, n_cp=n_cp, n_tp=n_tp)
-    if n_tp > 1:
+    mesh = parallel_mesh(
+        oc.batch_size, oc.validation_batch_size, n_cp=n_cp, n_tp=n_tp, n_fsdp=n_fsdp
+    )
+    if n_tp > 1 or n_fsdp > 1:
         from .sharding import shard_state
 
-        place_state = lambda s: shard_state(s, mesh)  # noqa: E731
+        strict_sharding = bool(tc.get("strict_sharding", False))
+        place_state = lambda s: shard_state(s, mesh, strict=strict_sharding)  # noqa: E731
     else:
         place_state = lambda s: replicate(s, mesh)  # noqa: E731
     place_batch = shard_batch_cp if n_cp > 1 else shard_batch
@@ -696,6 +733,17 @@ def train(
         tc.get("device_resident_max_bytes") or DeviceDataset.DEFAULT_BUDGET_BYTES
     )
     device_train = device_tuning = None
+    if n_fsdp > 1:
+        # The resident tables shard over the `data` axis and deal plans per
+        # data shard; an fsdp axis splits the batch dimension further than
+        # the plan stream deals. Host collation + shard_batch handles the
+        # (data, fsdp) layout; the resident fast path is an open follow-up.
+        if resident_mode is True:
+            raise ValueError(
+                "device_resident_data: true is not supported with fsdp_shards > 1; "
+                "use 'auto' (host collation) for FSDP runs."
+            )
+        resident_mode = False
     if resident_mode is True:
         # Explicit opt-in: unsupported topologies (and shard-indivisible
         # batch sizes) raise a clear error here instead of a full epoch in.

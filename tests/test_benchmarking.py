@@ -90,10 +90,19 @@ class TestBenchTailCapture:
     a reordering or a bloated tail fails in tier-1, not in a lost artifact."""
 
     HEADLINE_MARKER = "---- headline block"
-    # Every r09 key the acceptance list names, plus the historical headline
-    # keys whose position the r06/r07/r08 rounds already relied on.
+    # Every key the r09/r10 acceptance lists name, plus the historical
+    # headline keys whose position the r06-r08 rounds already relied on.
+    # The r10 width-ladder / fsdp / scan-flatness keys are pinned here so
+    # the scale-up verdicts (per-rung step ms + MFU, the 4096 rung's
+    # FSDP-only footprint, depth-flat compile ratios, the pod-scale
+    # prediction) always land inside the driver's 2000-char tail capture.
     REQUIRED_TAIL_KEYS = [
         "width1024_remat_ab_ms",
+        "width_ladder_step_ms",
+        "width_ladder_mfu",
+        "width_ladder_pod_step_ms_pred",
+        "fsdp_width4096_state_gb",
+        "scan_depth_flat",
         "na_fused_ab_probe_ms",
         "dep_graph_pallas_ab_ms",
         "engine_events_per_sec_per_chip",
@@ -136,6 +145,15 @@ class TestBenchTailCapture:
                     "unfused_attention": 9999.99,
                     "full_plane_heads": 9999.99,
                     "dep_graph_xla_fused": 9999.99,
+                }
+            if key.startswith("width_ladder_"):  # one entry per ladder rung
+                return {"1024": 99999.99, "2048": 99999.99, "4096": 99999.99}
+            if key == "scan_depth_flat":  # d8/d2 ratios, scan vs unrolled
+                return {
+                    "scan_hlo": 99.99,
+                    "unrolled_hlo": 99.99,
+                    "scan_compile": 99.99,
+                    "unrolled_compile": 99.99,
                 }
             if key.endswith("_ab_ms"):
                 return {"first_arm_name_here": 9999.99, "second_arm_name": 9999.99}

@@ -687,6 +687,48 @@ class TestLoweredProgramGates:
         assert check_no_f64(text, "pretrain:na_pallas_dp8") == []
         assert check_no_host_transfers(text, "pretrain:na_pallas_dp8") == []
 
+    def test_scan_and_fsdp_steps_are_f64_and_host_transfer_free(self):
+        """The r10 scale-up programs: the scan-over-layers pretrain step on
+        the dp8 mesh (one scanned block body — the stacked-param relayout
+        must not smuggle f64 constants or callbacks into the loop) and the
+        FSDP step (scan + parameter/optimizer sharding over an 8-way fsdp
+        axis — the gather-on-use/reduce-scatter-on-grad schedule is pure
+        collectives, never host traffic)."""
+        from eventstreamgpt_tpu.analysis.program_checks import (
+            canonical_pretrain_step,
+            check_no_f64,
+            check_no_host_transfers,
+        )
+
+        fn, args = canonical_pretrain_step(8, 1, scan=True)
+        text = fn.lower(*args).as_text()
+        assert check_no_f64(text, "pretrain:scan_dp8") == []
+        assert check_no_host_transfers(text, "pretrain:scan_dp8") == []
+
+        fn, args = canonical_pretrain_step(1, 1, scan=True, n_fsdp=8)
+        text = fn.lower(*args).as_text()
+        assert check_no_f64(text, "pretrain:fsdp8") == []
+        assert check_no_host_transfers(text, "pretrain:fsdp8") == []
+
+    def test_scan_and_fsdp_budgets_are_committed(self):
+        """COLLECTIVES.json carries the r10 budgets the Tier-B gate holds
+        the compiled programs to: scan_dp8 (byte-identical to dp8 — the
+        scan relayout adds zero communication) and fsdp8, the one layout
+        whose bytes are all-gather dominated by design (sharded weights
+        gathered on use; at the canonical toy shapes XLA folds the grad
+        reduce-scatter into its all-reduce), with the n_params the bench
+        width ladder derives its pod-scale prediction factor from."""
+        import json
+
+        from eventstreamgpt_tpu.analysis.program_checks import REPO_ROOT
+
+        budgets = json.loads((REPO_ROOT / "COLLECTIVES.json").read_text())["layouts"]
+        assert "scan_dp8" in budgets and "fsdp8" in budgets
+        assert budgets["scan_dp8"]["total_bytes"] == budgets["dp8"]["total_bytes"]
+        fsdp = budgets["fsdp8"]
+        assert fsdp["all-gather"]["bytes"] > 0, "FSDP must gather sharded weights"
+        assert fsdp["n_params"] > 0, "the width ladder needs n_params in the entry"
+
     def test_service_programs_are_f64_and_host_transfer_free(self):
         """The online service's dispatch programs (2-replica service over
         dp8): the async double-buffered pipeline is only host-transfer-free
