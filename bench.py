@@ -619,8 +619,13 @@ def main():
     # events, so the window is long enough at K=3).
     from eventstreamgpt_tpu.utils.benchmarking import readback_echo_ms
 
+    # decode_scan donates its batch+caches (they are consumed and returned
+    # in the carry), so every re-invocation must thread the carry back in —
+    # reusing the original arrays would dispatch deleted buffers. The
+    # rebinding is host tuple indexing; the timed device work is identical.
     out_carry = steps["decode_scan"](state.params, big, caches, cursor + 1, gen_key)
     drain(out_carry[0].event_mask)  # warm
+    big, caches = out_carry[0], out_carry[1]
     K_SCANS = 3
     scan_best = float("inf")
     for _ in range(2):
@@ -628,6 +633,7 @@ def main():
         t0 = time.perf_counter()
         for _k in range(K_SCANS):
             out_carry = steps["decode_scan"](state.params, big, caches, cursor + 1, gen_key)
+            big, caches = out_carry[0], out_carry[1]
         drain(out_carry[0].event_mask)
         window = 1000.0 * (time.perf_counter() - t0) - rtt
         scan_best = min(scan_best, max(window, 0.0) / K_SCANS)
@@ -1190,6 +1196,16 @@ def main():
         # HLO-size probe OUTSIDE the timed window: text serialization is
         # not compile work and would skew the depth/width compile story.
         detail["hlo_chars"] = len(lowered_w.as_text())
+        # The analyzer-derived per-device peak (XLA buffer assignment, the
+        # graftcheck Tier C number) next to the analytic train_state_bytes
+        # estimate: the analytic figure decides the rung's layout up front,
+        # the analyzer figure is what the compiled executable actually pins
+        # — divergence between them is a capacity-planning bug.
+        from eventstreamgpt_tpu.analysis.memory_checks import peak_hbm_bytes
+
+        detail["peak_hbm_bytes_analyzer"] = peak_hbm_bytes(
+            compiled_w.memory_analysis()
+        )
         state_w, wl = compiled_w(state_w, batch_w, rng)
         drain(wl)
         tunnel_probe(f"width{w}", extras)

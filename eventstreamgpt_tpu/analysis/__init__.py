@@ -6,17 +6,27 @@ Three tiers, one CLI (``scripts/graftcheck.py``):
   TPU footguns runtime tests only catch after they've burned a pod-hour:
   host syncs reachable from traced scopes or jitted-dispatch loops, f64
   dtype creep, PRNG key reuse, Python control flow on traced values, and
-  undonated train-step jits.
+  undonated state-updating jits (train/fine-tune steps and the serving
+  decode/prefill/dispatch programs).
 * Tier B — ``program_checks``: AOT-lower the canonical pretrain / fine-tune /
   generation step programs and assert static facts of the lowered module:
   no f64 element types, no host transfers, collective payload bytes within
-  tolerance of the committed ``COLLECTIVES.json`` budget.
+  per-kind tolerance of the committed ``COLLECTIVES.json`` budget.
+* Tier C — ``program_census`` + ``memory_checks``: the whole-fleet census.
+  Every ``aot_programs`` provider registers its compiled-program factories;
+  each program is AOT-compiled at toy AND scaled (width >= 2048) shapes and
+  audited from its buffer assignment: peak HBM vs ``MEMORY.json`` (the
+  width-4096 replicated rung must FAIL the 16 GB chip budget, fsdp8 must
+  fit), donation-aliasing completeness, implicit resharding, and
+  kind-resolved collective inventories (the scaled fsdp8 backward must
+  show reduce-scatter).
 * ``compile_guard``: a recompilation sentinel (context manager over the jit
   trace caches / ``jax.monitoring`` compile events) used by tests and by
   ``training/pretrain.py`` to fail fast if the step recompiles mid-epoch.
 
-``lint`` is pure stdlib (no jax import) so Tier A runs anywhere in
-milliseconds; the jax-importing tiers are deferred to submodule imports.
+``lint`` and the ``program_census`` registry are pure stdlib (no jax
+import) so Tier A and provider registration run anywhere in milliseconds;
+the jax-importing tiers are deferred to submodule imports.
 """
 
 from .lint import (  # noqa: F401

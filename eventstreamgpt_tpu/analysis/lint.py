@@ -49,6 +49,7 @@ __all__ = [
     "load_baseline",
     "save_baseline",
     "apply_baseline",
+    "prune_baseline",
 ]
 
 RULES: dict[str, str] = {
@@ -56,8 +57,14 @@ RULES: dict[str, str] = {
     "GC002": "float64 dtype outside the host-side preprocessing allowlist",
     "GC003": "PRNG key consumed twice without an intervening split/fold_in",
     "GC004": "Python if/while on a traced value inside a traced scope",
-    "GC005": "train-step jax.jit without donate_argnums",
+    "GC005": "state-updating jit (train/fine-tune step, decode/prefill/dispatch) without donate_argnums",
 }
+
+# GC005 trigger vocabulary: jits of state-updating steps. "train" covers the
+# pretrain AND fine-tune step factories (both jit `*train_step*` bodies);
+# decode/prefill/dispatch cover the serving engine's and service's hot-loop
+# jits, whose undonated state would double-buffer every slot's KV cache.
+_GC005_NAME_RE = re.compile(r"train|decode|prefill|dispatch|finetune|fine_tune")
 
 # Paths where f64 is the *point* (pandas/preprocessing fit statistics run
 # host-side at full precision; synthetic data generation is host-only).
@@ -858,20 +865,30 @@ class _Linter:
     # ------------------------------------------------------------- GC005
     def check_gc005(self) -> None:
         hint = (
-            "donate the state: jax.jit(step, donate_argnums=(0,)) so parameters and "
-            "optimizer moments update in place instead of double-buffering HBM"
+            "donate the mutated state: jax.jit(step, donate_argnums=(0,)) (train "
+            "state) / donate_argnums=(1,) (engine decode/prefill state) so the "
+            "update happens in place instead of double-buffering HBM"
         )
 
         def jit_target_names(call: ast.Call, scope: _Func | None) -> set[str]:
             names: set[str] = set()
             if call.args:
-                a = call.args[0]
-                if isinstance(a, ast.Name):
-                    names.add(a.id)
-                elif isinstance(a, ast.Call):
-                    t = _tail(_dotted(a.func))
-                    if t:
-                        names.add(t)
+                candidates = [call.args[0]]
+                # `jax.jit(self._decode_a if na else self._decode_b, ...)`:
+                # both branches name the step.
+                if isinstance(call.args[0], ast.IfExp):
+                    candidates = [call.args[0].body, call.args[0].orelse]
+                for a in candidates:
+                    if isinstance(a, ast.Name):
+                        names.add(a.id)
+                    elif isinstance(a, ast.Attribute):
+                        # `jax.jit(self._decode_chunk_ci)` — method steps on
+                        # the engine/service classes.
+                        names.add(a.attr)
+                    elif isinstance(a, ast.Call):
+                        t = _tail(_dotted(a.func))
+                        if t:
+                            names.add(t)
             return names
 
         scopes: list[tuple] = [(self.mod.module_own_walk(), None)]
@@ -888,35 +905,42 @@ class _Linter:
                 parent_assign = getattr(node, "_gc_parent_assign", None)
                 if parent_assign:
                     names |= parent_assign
-                if any("train" in n.lower() for n in names):
+                if any(_GC005_NAME_RE.search(n.lower()) for n in names):
                     self.add(
                         node, "GC005",
-                        f"train-step jit of `{'/'.join(sorted(names))}` without donation",
+                        f"state-updating jit of `{'/'.join(sorted(names))}` without donation",
                         hint,
                     )
-        # decorator form: @jax.jit on a def whose name says train
+        # decorator form: @jax.jit on a def whose name says train/decode/...
         for f in self.mod.funcs:
             for dec in getattr(f.node, "decorator_list", []):
                 d = dec.func if isinstance(dec, ast.Call) else dec
-                if _tail(_dotted(d)) in _JIT_NAMES and "train" in f.name.lower():
+                if _tail(_dotted(d)) in _JIT_NAMES and _GC005_NAME_RE.search(f.name.lower()):
                     kwargs = (
                         {kw.arg for kw in dec.keywords} if isinstance(dec, ast.Call) else set()
                     )
                     if not (kwargs & {"donate_argnums", "donate_argnames"}):
                         self.add(
                             dec, "GC005",
-                            f"train-step jit of `{f.name}` without donation",
+                            f"state-updating jit of `{f.name}` without donation",
                             hint,
                         )
 
 
 def _annotate_assign_names(tree: ast.Module) -> None:
-    """Tags jit calls with their assignment-target names (for GC005)."""
+    """Tags jit calls with their assignment-target names (for GC005).
+
+    Attribute targets count too: ``self._decode_jit = jax.jit(...)`` names
+    the step just as well as a local — the serving engine's dispatch jits
+    are all attribute-bound."""
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-            names = {
-                t.id for t in node.targets if isinstance(t, ast.Name)
-            }
+            names = set()
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    names.add(t.attr)
             if names:
                 node.value._gc_parent_assign = names  # type: ignore[attr-defined]
 
@@ -962,10 +986,7 @@ def load_baseline(fp: Path) -> dict[tuple[str, str, str], int]:
     return out
 
 
-def save_baseline(findings: list[Finding], fp: Path) -> None:
-    counts: dict[tuple[str, str, str], int] = {}
-    for f in findings:
-        counts[f.key()] = counts.get(f.key(), 0) + 1
+def _write_baseline_file(counts: dict[tuple[str, str, str], int], fp: Path) -> None:
     recs = [
         {"path": p, "rule": r, "snippet": s, "count": c}
         for (p, r, s), c in sorted(counts.items())
@@ -976,7 +997,8 @@ def save_baseline(findings: list[Finding], fp: Path) -> None:
                 "note": (
                     "graftcheck lint baseline: pre-existing findings suppressed by key "
                     "(path, rule, snippet). New findings fail; shrink this file, never "
-                    "grow it. Regenerate with scripts/graftcheck.py --write-baseline."
+                    "grow it. Regenerate with scripts/graftcheck.py --write-baseline; "
+                    "drop stale entries with scripts/graftcheck.py baseline --prune."
                 ),
                 "findings": recs,
             },
@@ -984,6 +1006,38 @@ def save_baseline(findings: list[Finding], fp: Path) -> None:
         )
         + "\n"
     )
+
+
+def save_baseline(findings: list[Finding], fp: Path) -> None:
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    _write_baseline_file(counts, fp)
+
+
+def prune_baseline(
+    findings: list[Finding], baseline: dict[tuple[str, str, str], int]
+) -> tuple[dict[tuple[str, str, str], int], int]:
+    """Drops baseline budget no current finding consumes.
+
+    Returns ``(pruned baseline, stale count)``: each entry's count shrinks
+    to the number of matching findings actually present (entries with no
+    match disappear), and the stale count is the total suppression budget
+    removed. Fixed findings otherwise leave their entries behind forever —
+    dead budget a future regression at the same (path, rule, snippet) key
+    would silently spend.
+    """
+    present: dict[tuple[str, str, str], int] = {}
+    for f in findings:
+        present[f.key()] = present.get(f.key(), 0) + 1
+    pruned: dict[tuple[str, str, str], int] = {}
+    stale = 0
+    for key, count in baseline.items():
+        keep = min(count, present.get(key, 0))
+        stale += count - keep
+        if keep:
+            pruned[key] = keep
+    return pruned, stale
 
 
 def apply_baseline(

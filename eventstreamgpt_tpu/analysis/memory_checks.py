@@ -1,0 +1,245 @@
+"""Static HBM, donation, and resharding analysis of compiled programs.
+
+Tier C of graftcheck extracts three classes of facts from an AOT-compiled
+executable — no execution, no hardware:
+
+* **peak HBM** from XLA's buffer assignment (``compiled.memory_analysis()``):
+  per-device argument + output + temp + generated-code bytes, net of
+  donation aliasing. This is the number that decides whether a layout fits
+  a 16 GB chip *before* a single device step — the pjit-era playbook for
+  catching OOMs at compile time.
+* **donation completeness** from the module's ``input_output_alias`` map:
+  every leaf of a donated argument must actually be aliased to an output
+  buffer in the compiled program. A donated-but-unaliased buffer
+  double-buffers silently — GC005 passing at the AST level only proves the
+  ``donate_argnums`` was *written*, not that XLA could honor it (dtype or
+  sharding mismatches between the donated input and its output make the
+  donation a no-op, with a warning nobody reads).
+* **implicit resharding** by diffing the shardings the caller declared on
+  the arguments against the shardings the compiled executable expects.
+  With sharding propagation to parameters disabled (jax's default) these
+  match; a mismatch means every dispatch silently device_puts — a
+  per-step resharding tax invisible in the program text.
+
+All helpers take the compiled object (``jitted.lower(...).compile()``) and
+stay pure-analysis: nothing here allocates device buffers beyond what
+lowering itself does.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+__all__ = [
+    "memory_report",
+    "peak_hbm_bytes",
+    "donation_report",
+    "resharding_report",
+    "compare_memory",
+    "check_hbm_fit",
+]
+
+# input_output_alias entries: "{out_index}: (param_number, {param_index}, kind)"
+_ALIAS_ENTRY_RE = re.compile(r"\{[\d,\s]*\}:\s*\((\d+),\s*\{[\d,\s]*\}")
+
+
+def peak_hbm_bytes(mem_stats: Any) -> int:
+    """Per-device peak HBM of a compiled executable's buffer assignment.
+
+    ``arguments + outputs - aliased + temps + generated code``: donated
+    (aliased) outputs reuse their input buffers, everything else is live at
+    peak. Activations the schedule materializes land in ``temp``; this is
+    the static floor a real step cannot go below.
+    """
+    return int(
+        mem_stats.argument_size_in_bytes
+        + mem_stats.output_size_in_bytes
+        - mem_stats.alias_size_in_bytes
+        + mem_stats.temp_size_in_bytes
+        + mem_stats.generated_code_size_in_bytes
+    )
+
+
+def memory_report(compiled: Any) -> dict:
+    """The committed-to-``MEMORY.json`` memory facts of one executable."""
+    ms = compiled.memory_analysis()
+    return {
+        "peak_hbm_bytes": peak_hbm_bytes(ms),
+        "argument_bytes": int(ms.argument_size_in_bytes),
+        "output_bytes": int(ms.output_size_in_bytes),
+        "alias_bytes": int(ms.alias_size_in_bytes),
+        "temp_bytes": int(ms.temp_size_in_bytes),
+        "generated_code_bytes": int(ms.generated_code_size_in_bytes),
+    }
+
+
+def _kept_flat_indices(compiled: Any, n_leaves: int) -> list[int]:
+    """The flat argument-leaf indices the compiled executable kept.
+
+    jit prunes unused arguments by default, so the compiled module's
+    parameter numbers index the *kept* leaves, not the caller's flat
+    leaves. Falls back to the identity when the executable doesn't expose
+    the kept set (analysis must degrade, never crash)."""
+    ex = getattr(compiled, "_executable", None)
+    kept = getattr(ex, "_kept_var_idx", None)
+    if kept is None:
+        kept = getattr(ex, "kept_var_idx", None)
+    if kept is None:
+        return list(range(n_leaves))
+    return sorted(kept)
+
+
+def donation_report(
+    compiled: Any, args: tuple, donate_argnums: tuple, hlo_text: str | None = None
+) -> dict:
+    """Donated-leaf vs actually-aliased audit of one compiled program.
+
+    Flattens ``args`` the way jit does (donated argument *leaves* occupy a
+    contiguous range of flat parameter numbers per argument), maps through
+    the executable's kept-argument set (pruned leaves hold no buffer and
+    cannot double-buffer), parses the compiled module's
+    ``input_output_alias`` header, and reports every donated leaf whose
+    compiled parameter number is not aliased to any output. Returns
+    ``{"n_donated", "n_aliased", "n_pruned", "undonated"}`` where
+    ``undonated`` names each unaliased leaf by argument index and flat
+    offset — an undonated-in-practice buffer is exactly the
+    double-buffering GC005's AST check cannot see. ``n_donated ==
+    n_aliased + n_pruned`` when the audit is clean. ``hlo_text`` lets
+    callers that already serialized the optimized module pass it in
+    (``compiled.as_text()`` is not cheap at fleet scale).
+    """
+    import jax
+
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
+    header = next(
+        (l for l in hlo_text.splitlines() if "input_output_alias=" in l),
+        "",
+    )
+    aliased_params = {int(m.group(1)) for m in _ALIAS_ENTRY_RE.finditer(header)}
+
+    flat_ranges: list[tuple[int, int]] = []  # per-arg (start, stop) flat leaf range
+    pos = 0
+    for a in args:
+        n = len(jax.tree_util.tree_leaves(a))
+        flat_ranges.append((pos, pos + n))
+        pos += n
+    kept = _kept_flat_indices(compiled, pos)
+    kept_pos = {flat: i for i, flat in enumerate(kept)}  # flat -> compiled param no.
+
+    donated_leaves = 0
+    pruned = 0
+    undonated: list[str] = []
+    for argnum in donate_argnums:
+        start, stop = flat_ranges[argnum]
+        for flat in range(start, stop):
+            donated_leaves += 1
+            if flat not in kept_pos:
+                pruned += 1
+                continue
+            if kept_pos[flat] not in aliased_params:
+                undonated.append(
+                    f"arg {argnum} leaf {flat - start} (compiled parameter {kept_pos[flat]})"
+                )
+    return {
+        "n_donated": donated_leaves,
+        "n_aliased": donated_leaves - pruned - len(undonated),
+        "n_pruned": pruned,
+        "undonated": undonated,
+    }
+
+
+def _normalized_spec(sharding: Any) -> tuple | None:
+    """A NamedSharding's PartitionSpec as a trailing-None-free tuple, or
+    ``None`` for shardings without a spec (single-device, GSPMD opaque)."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out = list(spec)
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+def resharding_report(compiled: Any, args: tuple) -> list[str]:
+    """Declared argument shardings vs the compiled executable's layouts.
+
+    Walks the flattened arguments beside ``compiled.input_shardings``; every
+    leaf whose declared ``NamedSharding`` spec differs from the spec the
+    executable expects is an implicit reshard: jax will silently copy that
+    argument to the compiled layout on every dispatch. Leaves without a
+    declared NamedSharding (host numpy, single-device arrays) are skipped —
+    there is nothing declared to diff against.
+    """
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(args)
+    kept = _kept_flat_indices(compiled, len(leaves))
+    leaves = [leaves[i] for i in kept if i < len(leaves)]
+    compiled_in = jax.tree_util.tree_leaves(compiled.input_shardings[0])
+    problems: list[str] = []
+    if len(leaves) != len(compiled_in):
+        return [
+            f"argument flattening mismatch: {len(leaves)} kept leaves vs "
+            f"{len(compiled_in)} compiled input shardings (analyzer skew)"
+        ]
+    for i, (leaf, got) in enumerate(zip(leaves, compiled_in)):
+        declared = _normalized_spec(getattr(leaf, "sharding", None))
+        actual = _normalized_spec(got)
+        if declared is None or actual is None:
+            continue
+        if declared != actual:
+            problems.append(
+                f"flat arg {i}: declared PartitionSpec{declared} but the "
+                f"compiled program expects PartitionSpec{actual} — every "
+                "dispatch reshards this argument"
+            )
+    return problems
+
+
+def compare_memory(
+    report: dict,
+    budget: dict,
+    rel_tol: float = 0.10,
+    abs_slack: int = 1 << 20,
+) -> list[str]:
+    """Gates a `memory_report` against its committed ``MEMORY.json`` entry.
+
+    ``peak_hbm_bytes`` must stay within ``budget * (1 + rel_tol) +
+    abs_slack``; shrinking never fails (refresh the budget). The breakdown
+    fields are informational — temp bytes move with XLA scheduling choices,
+    but the peak is the number serving capacity is planned against.
+    """
+    problems: list[str] = []
+    have = int(report.get("peak_hbm_bytes", 0))
+    want = int(budget.get("peak_hbm_bytes", 0))
+    if have > want * (1.0 + rel_tol) + abs_slack:
+        problems.append(
+            f"peak HBM {have}B exceeds committed budget {want}B "
+            f"(+{rel_tol:.0%} + {abs_slack}B slack)"
+        )
+    return problems
+
+
+def check_hbm_fit(report: dict, hbm_budget_gb: float, expect_fit: bool, label: str) -> list[str]:
+    """Asserts a program's peak HBM lands on the expected side of the chip
+    budget. ``expect_fit=False`` is the negative control: the width-4096
+    replicated layout MUST fail a 16 GB chip — if it suddenly "fits", the
+    analyzer (or the layout) broke, and trusting it would OOM real silicon.
+    """
+    peak = int(report.get("peak_hbm_bytes", 0))
+    budget = int(hbm_budget_gb * 1e9)
+    fits = peak <= budget
+    if fits and not expect_fit:
+        return [
+            f"{label}: peak HBM {peak / 1e9:.2f} GB unexpectedly fits the "
+            f"{hbm_budget_gb:g} GB budget — this layout is the analyzer's "
+            "negative control and must exceed it"
+        ]
+    if not fits and expect_fit:
+        return [
+            f"{label}: peak HBM {peak / 1e9:.2f} GB exceeds the "
+            f"{hbm_budget_gb:g} GB chip budget"
+        ]
+    return []

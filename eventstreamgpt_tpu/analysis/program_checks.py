@@ -125,7 +125,7 @@ def canonical_pretrain_step(
 
     from ..models.config import OptimizationConfig
     from ..training import TrainState, build_optimizer, make_train_step, shard_batch
-    from ..training.sharding import make_mesh, shard_state
+    from ..training.sharding import make_mesh, make_state_shardings
 
     ge = _graft_entry()
     _require_devices(n_data * n_model * n_fsdp)
@@ -153,9 +153,15 @@ def canonical_pretrain_step(
     )
     tx, _ = build_optimizer(oc)
     state = TrainState(step=jnp.zeros((), jnp.int32), params=params, opt_state=tx.init(params))
-    state = shard_state(state, mesh)
+    shardings = make_state_shardings(state, mesh)
+    state = jax.device_put(state, shardings)
     batch = shard_batch(batch, mesh)
-    step = make_train_step(model, tx, with_health=with_health)
+    # Parameter-sharding layouts (tp/fsdp) pin the output state to the input
+    # layout: without the pin GSPMD propagation reshards small replicated
+    # leaves over `model`, silently dropping their donation (the Tier C
+    # donation audit's dp4_tp2 finding) and forcing a reshard-per-dispatch.
+    pin = shardings if (n_model > 1 or n_fsdp > 1) else None
+    step = make_train_step(model, tx, with_health=with_health, out_state_shardings=pin)
     return step, (state, batch, jax.random.PRNGKey(0))
 
 
@@ -295,12 +301,16 @@ def canonical_kvq_engine_programs(n_data: int = 8) -> dict:
 
 
 def canonical_sampling_engine_program() -> dict:
-    """The fused-sampling decode program, unsharded (one device, the
+    """The fused-sampling engine programs, unsharded (one device, the
     single-replica serving topology the kernel targets): int8 cache +
-    the Pallas sampling kernel in interpreter mode. Gated f64-free and
-    host-transfer-free — the kernel's masked-fill/gumbel/argmax epilogue
-    must not smuggle callbacks into the decode hot loop — and against a
-    zero-collective budget (single device ⇒ any collective is a bug)."""
+    the Pallas sampling kernel in interpreter mode. The decode program is
+    gated f64-free and host-transfer-free — the kernel's
+    masked-fill/gumbel/argmax epilogue must not smuggle callbacks into the
+    decode hot loop — and against a zero-collective budget (single device
+    ⇒ any collective is a bug). Returns the engine's full ``aot_programs``
+    dict (prefill + boundary pack included) so the Tier C census covers
+    every program this topology can compile, not just the budget-gated
+    decode."""
     import jax
 
     from ..serving import GenerationEngine
@@ -320,7 +330,7 @@ def canonical_sampling_engine_program() -> dict:
         kv_cache_dtype="int8",
         sampling_impl="pallas_interpret",
     )
-    return {"decode": engine.aot_programs(bucket_len=8, group=2)["decode"]}
+    return engine.aot_programs(bucket_len=8, group=2)
 
 
 def canonical_service_programs(n_data: int = 8) -> dict:
@@ -527,6 +537,7 @@ def run_program_checks(
         budget_keys["service:decode"] = "service_dp8"
         budget_keys["service:prefill_b8"] = "service_prefill_dp8"
         budget_keys["service:boundary_pack"] = "service_boundary_dp8"
+        budget_keys["service:decode_r1"] = "service_r1_dp8"
         for label, budget_key in budget_keys.items():
             log(f"compiling {label} for the collective budget gate")
             compiled = lowered[label].compile()

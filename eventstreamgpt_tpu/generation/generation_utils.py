@@ -26,6 +26,7 @@ and the training-time attention pattern (see
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Optional
 
 import jax
@@ -434,7 +435,11 @@ def _build_ci_steps(model, config, B, input_len, max_new_events):
         )
         return out.preds, out.past_key_values
 
-    @jax.jit
+    # The caches are consumed and rebound every step (`preds, caches =
+    # decode_step(params, big, caches, cursor)`), so they donate: the KV
+    # planes update in place instead of double-buffering a second
+    # (B, total_len) cache set per dispatch.
+    @partial(jax.jit, donate_argnums=(2,))
     def decode_step(params, big_batch, caches, cursor):
         view = _trim_to_event(big_batch, cursor - 1)
         out = model.apply(params, view, past=caches, use_cache=True, is_generation=True)
@@ -474,7 +479,10 @@ def _build_ci_steps(model, config, B, input_len, max_new_events):
         )
         return carry
 
-    decode_scan = jax.jit(decode_scan_body)
+    # The scan consumes the preallocated batch and the caches and returns
+    # their successors in the carry — both donate when dispatched as a
+    # standalone program.
+    decode_scan = jax.jit(decode_scan_body, donate_argnums=(1, 2))
 
     @jax.jit
     def generate_program(params, prompt_batch, key):
@@ -659,7 +667,10 @@ def _build_na_steps(model, config, B, input_len, max_new_events):
         )
         return carry
 
-    decode_scan = jax.jit(decode_scan_body)
+    # The scan consumes the preallocated batch and the caches and returns
+    # their successors in the carry — both donate when dispatched as a
+    # standalone program.
+    decode_scan = jax.jit(decode_scan_body, donate_argnums=(1, 2))
 
     @jax.jit
     def generate_program(params, prompt_batch, key):

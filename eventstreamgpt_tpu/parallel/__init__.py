@@ -9,7 +9,12 @@ results — communication overlaps compute and no device ever materializes
 the full sequence.
 """
 
-from .collectives_audit import audit_step, collective_inventory, compare_inventory
+from .collectives_audit import (
+    audit_step,
+    collective_inventory,
+    compare_inventory,
+    resolve_folded_reduce_scatters,
+)
 from .context import current_ring_context, ring_context
 from .ring_attention import ring_attention, ring_attention_shard
 
@@ -17,6 +22,7 @@ __all__ = [
     "audit_step",
     "collective_inventory",
     "compare_inventory",
+    "resolve_folded_reduce_scatters",
     "current_ring_context",
     "ring_attention",
     "ring_attention_shard",

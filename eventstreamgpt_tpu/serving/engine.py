@@ -1224,3 +1224,49 @@ class GenerationEngine:
             # host: it must stay a pure pack (no host callbacks, no f64).
             "boundary_pack": (self._pack_boundary_jit, (self._state,)),
         }
+
+
+# ------------------------------------------------- graftcheck Tier C census
+def _census_programs():
+    """The engine fleet for the Tier C census: every program the canonical
+    float, quantized-cache, and fused-sampling engines compile (straight
+    from their ``aot_programs`` — a new program key shows up here, or the
+    census-completeness gate fails). Decode and prefill donate the engine
+    state (argnum 1, matching `GenerationEngine.__init__`'s jits); the
+    boundary pack is a read-only pack and must NOT donate."""
+    from ..analysis import program_checks as pc
+    from ..analysis.program_census import CensusProgram
+
+    donate = {"decode": (1,), "prefill_b8": (1,), "boundary_pack": ()}
+    budget_keys = {
+        "engine:decode": "engine_dp8",
+        "engine:prefill_b8": "engine_prefill_dp8",
+        "engine_kvq:decode": "engine_kvq_dp8",
+        "engine_kvq:prefill_b8": "engine_kvq_prefill_dp8",
+        "engine_sampling:decode": "engine_sampling_1dev",
+    }
+    out = {}
+    for prefix, programs in (
+        ("engine", pc.canonical_engine_programs(8)),
+        ("engine_kvq", pc.canonical_kvq_engine_programs(8)),
+        ("engine_sampling", pc.canonical_sampling_engine_program()),
+    ):
+        for key, (fn, args) in programs.items():
+            label = f"{prefix}:{key}"
+            out[label] = CensusProgram(
+                label,
+                fn,
+                args,
+                donate_argnums=donate.get(key, ()),
+                budget_key=budget_keys.get(label),
+            )
+    return out
+
+
+def _register_census() -> None:
+    from ..analysis.program_census import register_aot_provider
+
+    register_aot_provider("engine", _census_programs)
+
+
+_register_census()

@@ -328,3 +328,42 @@ class ServingService:
                 bucket_len=bucket_len, group=group
             )["decode"]
         return programs
+
+
+# ------------------------------------------------- graftcheck Tier C census
+def _census_programs():
+    """The online service's dispatch fleet for the Tier C census: the
+    canonical 2-replica service's programs (replica 0's decode/prefill/
+    boundary pack plus replica 1's differently-chunked ``decode_r1``).
+    Decode and prefill donate the engine state; the boundary pack — the
+    one program whose output the host reads every chunk — must not."""
+    from ..analysis import program_checks as pc
+    from ..analysis.program_census import CensusProgram
+
+    donate = {"decode": (1,), "decode_r1": (1,), "prefill_b8": (1,)}
+    budget_keys = {
+        "service:decode": "service_dp8",
+        "service:prefill_b8": "service_prefill_dp8",
+        "service:boundary_pack": "service_boundary_dp8",
+        "service:decode_r1": "service_r1_dp8",
+    }
+    out = {}
+    for key, (fn, args) in pc.canonical_service_programs(8).items():
+        label = f"service:{key}"
+        out[label] = CensusProgram(
+            label,
+            fn,
+            args,
+            donate_argnums=donate.get(key, ()),
+            budget_key=budget_keys.get(label),
+        )
+    return out
+
+
+def _register_census() -> None:
+    from ..analysis.program_census import register_aot_provider
+
+    register_aot_provider("service", _census_programs)
+
+
+_register_census()

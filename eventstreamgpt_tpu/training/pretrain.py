@@ -274,19 +274,51 @@ def _train_step_body(model, tx, with_health: bool = False) -> Callable:
     return train_step
 
 
-def make_train_step(model, tx, with_health: bool = False) -> Callable:
+def make_train_step(
+    model, tx, with_health: bool = False, out_state_shardings=None
+) -> Callable:
     """A jitted ``(state, batch, rng) -> (state, loss)`` step.
 
     Gradients reduce across the ``data`` axis automatically (XLA inserts the
     psum for replicated-param/sharded-batch layouts). The state is donated so
     parameters update in place on device. ``with_health`` swaps the output
     for ``(state, (loss, health))`` (see `_train_step_body`).
+
+    ``out_state_shardings`` (a `TrainState` sharding tree, i.e.
+    `make_state_shardings` output) pins the output state to the input
+    layout. Without the pin, GSPMD's sharding propagation may choose a
+    DIFFERENT layout for updated parameters than the caller declared on the
+    inputs — on tensor-parallel meshes it reshards the small replicated
+    leaves (layer norms, biases) over ``model`` — which silently drops
+    their donation (input/output layouts no longer match, so the buffers
+    cannot alias: the graftcheck Tier C donation audit caught 48 such
+    leaves on dp4_tp2) and makes the second dispatch reshard or recompile.
+    Pass it whenever the state carries a parameter-sharding axis (tp/fsdp);
+    pure data-parallel layouts propagate P() unchanged and don't need it.
+    The loss (and health) outputs replicate — they are cross-replica
+    reductions already.
     """
-    return jax.jit(_train_step_body(model, tx, with_health=with_health), donate_argnums=(0,))
+    step = _train_step_body(model, tx, with_health=with_health)
+    if out_state_shardings is None:
+        return jax.jit(step, donate_argnums=(0,))
+    mesh = jax.tree_util.tree_leaves(out_state_shardings)[0].mesh
+    replicated = NamedSharding(mesh, P())
+    # (state, loss) or (state, (loss, health)): `replicated` is a tree
+    # prefix covering the whole auxiliary output.
+    return jax.jit(
+        step,
+        donate_argnums=(0,),
+        out_shardings=(out_state_shardings, replicated),
+    )
 
 
 def make_chunked_train_step(
-    model, tx, device_data, packed: bool = False, with_health: bool = False
+    model,
+    tx,
+    device_data,
+    packed: bool = False,
+    with_health: bool = False,
+    out_state_shardings=None,
 ) -> Callable:
     """A jitted ``(state, arrays, plans, rng) -> (state, losses)`` program
     that runs ``k`` collate+train steps in ONE dispatch.
@@ -335,7 +367,18 @@ def make_chunked_train_step(
 
         return jax.lax.scan(scan_body, state, plans)
 
-    return jax.jit(chunk_step, donate_argnums=(0,))
+    if out_state_shardings is None:
+        return jax.jit(chunk_step, donate_argnums=(0,))
+    # Same output-layout pin as make_train_step: on parameter-sharding
+    # meshes, unpinned GSPMD propagation reshards the small replicated
+    # leaves over `model` on output, silently dropping their donation.
+    mesh = jax.tree_util.tree_leaves(out_state_shardings)[0].mesh
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        chunk_step,
+        donate_argnums=(0,),
+        out_shardings=(out_state_shardings, replicated),
+    )
 
 
 def _plan_event_count(plans: dict, dataset: JaxDataset) -> int:
@@ -614,11 +657,17 @@ def train(
     mesh = parallel_mesh(
         oc.batch_size, oc.validation_batch_size, n_cp=n_cp, n_tp=n_tp, n_fsdp=n_fsdp
     )
+    state_shardings = None  # set by the first place_state on tp/fsdp layouts
     if n_tp > 1 or n_fsdp > 1:
-        from .sharding import shard_state
+        from .sharding import make_state_shardings
 
         strict_sharding = bool(tc.get("strict_sharding", False))
-        place_state = lambda s: shard_state(s, mesh, strict=strict_sharding)  # noqa: E731
+
+        def place_state(s):
+            nonlocal state_shardings
+            state_shardings = make_state_shardings(s, mesh, strict=strict_sharding)
+            return jax.device_put(s, state_shardings)
+
     else:
         place_state = lambda s: replicate(s, mesh)  # noqa: E731
     place_batch = shard_batch_cp if n_cp > 1 else shard_batch
@@ -716,7 +765,12 @@ def train(
             ckpt_mgr, state, place_state
         )
 
-    train_step = make_train_step(model, tx, with_health=with_health)
+    # tp/fsdp layouts pin the output state to the input layout (see
+    # make_train_step: unpinned propagation reshards replicated leaves over
+    # `model`, silently dropping their donation).
+    train_step = make_train_step(
+        model, tx, with_health=with_health, out_state_shardings=state_shardings
+    )
     eval_step = make_eval_step(model)
 
     # Device-resident data (round-5 feed-path redesign; data/device_dataset.py):
@@ -774,7 +828,12 @@ def train(
     chunk_steps = int(chunk_steps)
     chunked_step = (
         make_chunked_train_step(
-            model, tx, device_train, packed=use_packed, with_health=with_health
+            model,
+            tx,
+            device_train,
+            packed=use_packed,
+            with_health=with_health,
+            out_state_shardings=state_shardings,
         )
         if device_train is not None
         else None

@@ -283,3 +283,59 @@ def train_state_bytes(n_params: int, adam_moments: int = 2, grad_bytes: int = 4)
     16 GB/chip HBM budget to decide which rungs fit replicated and which
     are FSDP-only."""
     return int(n_params) * (4 * (1 + adam_moments) + grad_bytes)
+
+
+# ------------------------------------------------- graftcheck Tier C census
+def _census_programs():
+    """The training subsystem's compiled-program fleet for the Tier C
+    census: every canonical pretrain layout this module's meshes/shardings
+    can produce, plus the fine-tune steps. The builders are Tier B's
+    canonical constructions (same toy shapes, so the committed COLLECTIVES
+    budgets re-apply); the donated argument is always the train state."""
+    from ..analysis import program_checks as pc
+    from ..analysis.program_census import CensusProgram
+
+    specs = [
+        # (label, COLLECTIVES.json budget key, builder)
+        ("pretrain:dp8", "dp8", lambda: pc.canonical_pretrain_step(8, 1)),
+        ("pretrain:dp4_tp2", "dp4_tp2", lambda: pc.canonical_pretrain_step(4, 2)),
+        (
+            "pretrain:dp8_health",
+            "dp8",
+            lambda: pc.canonical_pretrain_step(8, 1, with_health=True),
+        ),
+        ("pretrain:na_dp8", "na_dp8", lambda: pc.canonical_pretrain_step(8, 1, na=True)),
+        (
+            "pretrain:na_pallas_dp8",
+            "na_pallas_dp8",
+            lambda: pc.canonical_pretrain_step(8, 1, na=True, na_impl="pallas_interpret"),
+        ),
+        ("pretrain:scan_dp8", "scan_dp8", lambda: pc.canonical_pretrain_step(8, 1, scan=True)),
+        (
+            "pretrain:fsdp8",
+            "fsdp8",
+            lambda: pc.canonical_pretrain_step(1, 1, scan=True, n_fsdp=8),
+        ),
+        ("finetune:dp8", None, lambda: pc.canonical_finetune_step(8)),
+        (
+            "finetune:dp8_health",
+            None,
+            lambda: pc.canonical_finetune_step(8, with_health=True),
+        ),
+    ]
+    out = {}
+    for label, budget_key, build in specs:
+        fn, args = build()
+        out[label] = CensusProgram(
+            label, fn, args, donate_argnums=(0,), budget_key=budget_key
+        )
+    return out
+
+
+def _register_census() -> None:
+    from ..analysis.program_census import register_aot_provider
+
+    register_aot_provider("training", _census_programs)
+
+
+_register_census()

@@ -7,22 +7,34 @@ suppressing pre-existing findings via ``eventstreamgpt_tpu/analysis/
 baseline.json``. Tier B AOT-lowers the canonical pretrain / fine-tune /
 generation step programs on an 8-device virtual CPU mesh and gates static
 program invariants: f64-free, host-transfer-free, collective payload within
-tolerance of ``COLLECTIVES.json``.
+tolerance of ``COLLECTIVES.json``. Tier C runs the whole-fleet program
+census (``analysis/program_census.py``): every registered ``aot_programs``
+provider's compiled programs — toy AND scaled shapes — audited for peak
+HBM vs ``MEMORY.json``, donation-aliasing completeness, implicit
+resharding, and kind-resolved collective inventories (the scaled fsdp8
+backward must show reduce-scatter).
 
 Usage:
     python scripts/graftcheck.py                 # Tier A over the repo
-    python scripts/graftcheck.py --tier all      # what CI runs
-    python scripts/graftcheck.py --write-baseline  # re-key the baseline
+    python scripts/graftcheck.py --tier all      # what CI runs (A+B+C)
+    python scripts/graftcheck.py --tier c --report-json report.json
+    python scripts/graftcheck.py --write-baseline  # re-key the lint baseline
+    python scripts/graftcheck.py --write-memory    # regenerate MEMORY.json
+    python scripts/graftcheck.py baseline --prune  # drop stale baseline entries
+    python scripts/graftcheck.py baseline --prune --check  # exit 1 if stale
     python scripts/graftcheck.py --list-rules
     python scripts/graftcheck.py path/to/file.py # lint specific files
 
-Exit codes: 0 clean, 1 new lint findings, 2 program-invariant violations.
-See docs/analysis.md for the rule catalog and baseline workflow.
+Exit codes: 0 clean, 1 new lint findings (or stale baseline under
+``baseline --prune --check``), 2 program-invariant violations.
+See docs/analysis.md for the rule catalog, baseline workflow, and the
+Tier C census contract.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -70,11 +82,60 @@ def run_tier_a(paths: list[Path], write_baseline: bool, no_baseline: bool) -> in
     return 0
 
 
-def run_tier_b(rel_tol: float, skip_compile: bool) -> int:
+def run_baseline_maintenance(prune: bool, check: bool) -> int:
+    """``graftcheck baseline --prune [--check]``: drop stale suppression.
+
+    A baseline entry whose (path, rule, snippet) key matches no current
+    finding is dead budget: the finding was fixed, but a future regression
+    at the same key would be silently suppressed. ``--prune`` rewrites the
+    file without the stale budget; ``--check`` only reports and exits 1 if
+    any exists (the CI mode — the baseline must stay tight at HEAD).
+    """
+    from eventstreamgpt_tpu.analysis.lint import (
+        _write_baseline_file,
+        default_targets,
+        lint_paths,
+        load_baseline,
+        prune_baseline,
+    )
+
+    if not prune and not check:
+        print("graftcheck[baseline]: nothing to do (pass --prune and/or --check)")
+        return 0
+    findings = lint_paths(default_targets(REPO_ROOT), REPO_ROOT)
+    baseline = load_baseline(BASELINE_FP)
+    pruned, stale = prune_baseline(findings, baseline)
+    kept = sum(pruned.values())
+    print(
+        f"graftcheck[baseline]: {len(baseline)} entrie(s) "
+        f"({sum(baseline.values())} suppression budget), {stale} stale, {kept} kept"
+    )
+    if check:
+        if stale:
+            print(
+                "graftcheck[baseline]: FAIL — stale entries present; run "
+                "`python scripts/graftcheck.py baseline --prune`"
+            )
+            return 1
+        print("graftcheck[baseline]: OK (no stale entries)")
+        return 0
+    if stale:
+        _write_baseline_file(pruned, BASELINE_FP)
+        print(f"graftcheck[baseline]: pruned {stale} stale suppression(s) -> {BASELINE_FP}")
+    else:
+        print("graftcheck[baseline]: no stale entries, file unchanged")
+    return 0
+
+
+def _provision_mesh() -> None:
     # The virtual CPU mesh must exist before the jax backend initializes.
     from __graft_entry__ import _provision_cpu_devices
 
     _provision_cpu_devices(8)
+
+
+def run_tier_b(rel_tol: float, skip_compile: bool) -> int:
+    _provision_mesh()
 
     from eventstreamgpt_tpu.analysis.program_checks import run_program_checks
 
@@ -95,13 +156,73 @@ def run_tier_b(rel_tol: float, skip_compile: bool) -> int:
     return 0
 
 
+def run_tier_c(report_json: Path | None, regen_memory: Path | None) -> int:
+    _provision_mesh()
+
+    from eventstreamgpt_tpu.analysis.program_census import run_census
+
+    problems, report = run_census(regen_path=regen_memory)
+    if regen_memory is not None:
+        print(f"graftcheck[C]: wrote regenerated memory budgets to {regen_memory}")
+    if report_json is not None:
+        report_json.write_text(json.dumps(report, indent=1, sort_keys=True) + "\n")
+        print(f"graftcheck[C]: wrote per-program report to {report_json}")
+    for p in problems:
+        print(f"graftcheck[C]: {p}")
+    if problems:
+        print(f"graftcheck[C]: FAIL — {len(problems)} violation(s)")
+        return 2
+    print(
+        f"graftcheck[C]: OK ({len(report)} programs: peak HBM within MEMORY.json, "
+        "donation aliasing complete, no implicit resharding, scaled fsdp8 "
+        "reduce-scatter visible)"
+    )
+    return 0
+
+
+def run_write_memory() -> int:
+    _provision_mesh()
+
+    from eventstreamgpt_tpu.analysis.program_census import write_memory_budgets
+
+    path, problems = write_memory_budgets()
+    for p in problems:
+        print(f"graftcheck[C]: {p}")
+    print(f"graftcheck[C]: wrote memory budgets to {path}")
+    if problems:
+        # A budget refresh must not paper over broken donation/resharding:
+        # the file is written (so diffs are inspectable) but the run fails.
+        print(f"graftcheck[C]: FAIL — {len(problems)} budget-independent violation(s)")
+        return 2
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "baseline":
+        bp = argparse.ArgumentParser(
+            prog="graftcheck baseline", description="lint-baseline maintenance"
+        )
+        bp.add_argument(
+            "--prune",
+            action="store_true",
+            help="drop baseline entries whose path+rule+snippet matches no current finding",
+        )
+        bp.add_argument(
+            "--check",
+            action="store_true",
+            help="with --prune: report only, exit 1 if stale entries exist (CI mode)",
+        )
+        bargs = bp.parse_args(argv[1:])
+        return run_baseline_maintenance(bargs.prune, bargs.check)
+
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
         "--tier",
-        choices=("a", "b", "all"),
+        choices=("a", "b", "c", "all"),
         default="a",
-        help="a: AST lint (default, fast); b: lowered-program gates; all: both (CI)",
+        help="a: AST lint (default, fast); b: lowered-program gates; "
+        "c: whole-fleet census (memory/donation/resharding); all: a+b+c (CI)",
     )
     ap.add_argument("paths", nargs="*", type=Path, help="lint these files only (Tier A)")
     ap.add_argument(
@@ -123,6 +244,24 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="Tier B: only the fast lowered-text gates, skip the compiled collective audit",
     )
+    ap.add_argument(
+        "--write-memory",
+        action="store_true",
+        help="regenerate MEMORY.json from a fresh Tier C census and exit",
+    )
+    ap.add_argument(
+        "--report-json",
+        type=Path,
+        default=None,
+        help="Tier C: write the per-program memory/collective report here (CI artifact)",
+    )
+    ap.add_argument(
+        "--regen-memory",
+        type=Path,
+        default=None,
+        help="Tier C: also write the regenerated MEMORY.json from the same census "
+        "pass (CI diffs it against the committed file without a second census)",
+    )
     ap.add_argument("--list-rules", action="store_true", help="print the rule catalog")
     args = ap.parse_args(argv)
 
@@ -140,6 +279,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{rule}: {desc}")
         return 0
 
+    if args.write_memory:
+        return run_write_memory()
+
     rc = 0
     if args.tier in ("a", "all"):
         rc = run_tier_a(args.paths, args.write_baseline, args.no_baseline)
@@ -147,6 +289,8 @@ def main(argv: list[str] | None = None) -> int:
             return rc
     if rc == 0 and args.tier in ("b", "all"):
         rc = run_tier_b(args.tolerance, args.skip_compile)
+    if rc == 0 and args.tier in ("c", "all"):
+        rc = run_tier_c(args.report_json, args.regen_memory)
     return rc
 
 
