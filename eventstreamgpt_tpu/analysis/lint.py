@@ -69,7 +69,11 @@ _GC005_NAME_RE = re.compile(r"train|decode|prefill|dispatch|finetune|fine_tune")
 # Paths where f64 is the *point* (pandas/preprocessing fit statistics run
 # host-side at full precision; synthetic data generation is host-only).
 F64_ALLOWLIST_DIRS = ("data/preprocessing/",)
-F64_ALLOWLIST_FILES = ("dataset_pandas.py", "synthetic.py")
+# serving/ingest.py is the online-admission TRANSFORM — the same host-side
+# numpy/pandas preprocessing the batch ETL runs (and must stay bit-identical
+# to it, f64 timestamps included); it never enters a traced scope (gated by
+# TestIngestPathGate).
+F64_ALLOWLIST_FILES = ("dataset_pandas.py", "synthetic.py", "ingest.py")
 
 # jax transforms whose function arguments execute under a trace.
 _TRACING_TRANSFORMS = {
@@ -535,7 +539,8 @@ class _Linter:
             return
         hint = (
             "use float32 (or bf16) on the accelerator path; f64 belongs only in "
-            "host-side preprocessing (data/preprocessing/, dataset_pandas.py, synthetic.py)"
+            "host-side preprocessing (data/preprocessing/, dataset_pandas.py, "
+            "synthetic.py, serving/ingest.py)"
         )
         f64_strs = {"float64", "f8", ">f8", "<f8", "double"}
         for node in ast.walk(self.tree):

@@ -46,29 +46,64 @@ DF_T = TypeVar("DF_T")
 
 # ------------------------------------------------------------ worker plumbing
 # Fork-based process-pool helpers for the subject/measurement-sharded ETL
-# phases. The dataset object is handed to workers through fork-inherited
-# memory (a global set just before the pool spawns) rather than pickling —
-# events/measurements frames can be GBs. Deterministic by construction:
-# results come back in task order and are merged in that order.
+# phases. The payload (dataset object, or a build-phase spec) is handed to
+# workers through fork-inherited memory (a global set just before the pool
+# spawns) rather than pickling — events/measurements frames can be GBs.
+# Deterministic by construction: results come back in task order and are
+# merged in that order.
 _FORK_SELF = None
 
 
-def _dl_rep_shard_worker(shard):
-    return _FORK_SELF.build_DL_cached_representation(subject_ids=list(shard))
+def _dl_rep_shard_to_disk_worker(task):
+    """Builds one DL-rep subject shard and streams it to parquet; only the
+    path travels back through the pipe, so parent+worker peak RSS is
+    O(shard), not O(chunk)."""
+    shard, fp = task
+    df = _FORK_SELF.build_DL_cached_representation(subject_ids=list(shard))
+    type(_FORK_SELF)._write_df(df, fp, do_overwrite=True)
+    return fp
 
 
 def _transform_measure_worker(measure):
     return _FORK_SELF._transform_one_measurement(measure)
 
 
-def _fork_map(dataset, worker, tasks, n_workers: int) -> list:
-    """Maps ``worker`` over ``tasks`` in a fork pool with ``dataset``
+def _etl_build_shard_worker(task):
+    """Builds one subject shard's raw event/measurement blocks and streams
+    them to parquet (see `DatasetBase.build_event_and_measurement_dfs_sharded`).
+
+    `_FORK_SELF` holds ``(cls, shards, subject_id_col, subject_id_dtype,
+    schemas_by_df, stream_dir)``; the task is the shard index. Returns a
+    manifest: ``(shard_idx, [(event_type, events_fp, meas_fp | None), ...])``
+    in serial block order.
+    """
+    cls, shards, subject_id_col, subject_id_dtype, schemas_by_df, stream_dir = _FORK_SELF
+    w = task
+    shard_map = shards[w]
+    manifest = []
+    for b, (event_type, events, meas) in enumerate(
+        cls._iter_source_blocks(
+            shard_map, subject_id_col, subject_id_dtype, schemas_by_df, keep_row_pos=True
+        )
+    ):
+        ev_fp = Path(stream_dir) / f"shard{w}_block{b}_events.parquet"
+        cls._write_df(events, ev_fp, do_overwrite=True)
+        me_fp = None
+        if meas is not None:
+            me_fp = Path(stream_dir) / f"shard{w}_block{b}_measurements.parquet"
+            cls._write_df(meas, me_fp, do_overwrite=True)
+        manifest.append((event_type, str(ev_fp), None if me_fp is None else str(me_fp)))
+    return (w, manifest)
+
+
+def _fork_map(payload, worker, tasks, n_workers: int) -> list:
+    """Maps ``worker`` over ``tasks`` in a fork pool with ``payload``
     visible as `_FORK_SELF`; preserves task order."""
     import multiprocessing as mp
     from concurrent.futures import ProcessPoolExecutor
 
     global _FORK_SELF
-    _FORK_SELF = dataset
+    _FORK_SELF = payload
     try:
         ctx = mp.get_context("fork")
         with ProcessPoolExecutor(
@@ -77,6 +112,27 @@ def _fork_map(dataset, worker, tasks, n_workers: int) -> list:
             return list(ex.map(worker, tasks))
     finally:
         _FORK_SELF = None
+
+
+def shard_subject_ids(subject_ids_map: dict, n_shards: int) -> list[dict]:
+    """Partitions a raw-key → numeric-id map into ``n_shards`` contiguous
+    sub-maps by mapped id (assignment order), dropping empty shards.
+
+    Contiguity by numeric id makes the plan deterministic for a given map
+    and keeps each subject's rows in exactly one worker — the property the
+    bit-identical merge (and per-shard dedup) relies on.
+
+    Examples:
+        >>> shard_subject_ids({"a": 0, "b": 1, "c": 2}, 2)
+        [{'a': 0, 'b': 1}, {'c': 2}]
+        >>> shard_subject_ids({"a": 0}, 4)
+        [{'a': 0}]
+    """
+    items = sorted(subject_ids_map.items(), key=lambda kv: kv[1])
+    n_shards = max(1, min(int(n_shards), len(items)))
+    bounds = np.linspace(0, len(items), n_shards + 1).round().astype(int)
+    shards = [dict(items[bounds[i] : bounds[i + 1]]) for i in range(n_shards)]
+    return [s for s in shards if s]
 INPUT_DF_T = TypeVar("INPUT_DF_T")
 
 
@@ -110,8 +166,13 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
     @classmethod
     @abc.abstractmethod
     def _load_input_df(cls, df, columns, subject_id_col=None, subject_ids_map=None,
-                       subject_id_dtype=None, filter_on=None, subject_id_source_col=None):
-        """Loads an input dataframe into the backend's format (``dataset_polars.py:147``)."""
+                       subject_id_dtype=None, filter_on=None, subject_id_source_col=None,
+                       keep_row_pos=False):
+        """Loads an input dataframe into the backend's format (``dataset_polars.py:147``).
+
+        ``keep_row_pos=True`` adds a ``__row_pos__`` column holding each
+        kept row's position in the loaded source (used by the sharded build
+        to restore serial row order on merge)."""
 
     @classmethod
     @abc.abstractmethod
@@ -195,6 +256,16 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         """Writes transformed columns back into an internal df (``:959``)."""
 
     @abc.abstractmethod
+    def _vocab_observations(self, measure, config, source_df):
+        """The vocabulary observation series for one measure — shared by the
+        from-scratch fit and the incremental append path."""
+
+    @abc.abstractmethod
+    def _incremental_update_numeric_fit(self, measure, config, source_df, stats_store):
+        """Merges a new shard's observations into persisted sufficient
+        statistics and refreshes moment-derived fit params."""
+
+    @abc.abstractmethod
     def _transform_numerical_measurement(self, measure, config, source_df):
         """Applies bounds/outlier/normalizer transforms (``:970``)."""
 
@@ -228,23 +299,31 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         return subjects_df, ID_map
 
     @classmethod
-    def build_event_and_measurement_dfs(
+    def _iter_source_blocks(
         cls,
         subject_ids_map: dict[Any, int],
         subject_id_col: str,
         subject_id_dtype: Any,
         schemas_by_df: dict[Any, list[InputDFSchema]],
-    ) -> tuple[DF_T, DF_T]:
-        """Builds events + measurements dfs from the schema map (``dataset_base.py:202``)."""
-        all_events_and_measurements = []
-        event_types = []
+        keep_row_pos: bool = False,
+    ):
+        """Yields ``(event_type, events_df, measurements_df | None)`` per
+        (source df, schema[, range-leg]) block, in the serial enumeration
+        order. The block structure depends only on the schema map — never on
+        which subjects are present — which is what lets the subject-sharded
+        build line its workers' outputs back up block by block.
 
+        ``keep_row_pos=True`` threads a ``__row_pos__`` column (the row's
+        position in its loaded source df) through to the outputs so a
+        sharded run can restore the exact serial row order on merge.
+        """
         for df, schemas in schemas_by_df.items():
             all_columns = list(itertools.chain.from_iterable(s.columns_to_load for s in schemas))
 
             try:
                 df = cls._load_input_df(
-                    df, all_columns, subject_id_col, subject_ids_map, subject_id_dtype
+                    df, all_columns, subject_id_col, subject_ids_map, subject_id_dtype,
+                    keep_row_pos=keep_row_pos,
                 )
             except Exception as e:
                 raise ValueError(f"Errored while loading {df}") from e
@@ -255,31 +334,31 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
                     sub_df = cls._filter_col_inclusion(sub_df, schema.filter_on)
                 if schema.type == InputDFType.EVENT:
                     sub_df = cls._resolve_ts_col(sub_df, schema.ts_col, "timestamp")
-                    all_events_and_measurements.append(
-                        cls._process_events_and_measurements_df(
-                            df=sub_df, event_type=schema.event_type,
-                            columns_schema=schema.unified_schema,
-                        )
+                    events, measurements = cls._process_events_and_measurements_df(
+                        df=sub_df, event_type=schema.event_type,
+                        columns_schema=schema.unified_schema,
                     )
-                    event_types.append(schema.event_type)
+                    yield schema.event_type, events, measurements
                 elif schema.type == InputDFType.RANGE:
                     sub_df = cls._resolve_ts_col(sub_df, schema.start_ts_col, "start_time")
                     sub_df = cls._resolve_ts_col(sub_df, schema.end_ts_col, "end_time")
                     for et, unified_schema, sp_df in zip(
                         schema.event_type, schema.unified_schema, cls._split_range_events_df(sub_df)
                     ):
-                        all_events_and_measurements.append(
-                            cls._process_events_and_measurements_df(
-                                sp_df, columns_schema=unified_schema, event_type=et
-                            )
+                        events, measurements = cls._process_events_and_measurements_df(
+                            sp_df, columns_schema=unified_schema, event_type=et
                         )
-                    event_types.extend(schema.event_type)
+                        yield et, events, measurements
                 else:
                     raise ValueError(f"Invalid schema type {schema.type}.")
 
+    @classmethod
+    def _merge_event_blocks(cls, blocks) -> tuple[DF_T, DF_T]:
+        """Assigns globally unique event ids across blocks and concatenates
+        (the tail of the historical ``build_event_and_measurement_dfs``)."""
         all_events, all_measurements = [], []
         running_event_id_max = 0
-        for event_type, (events, measurements) in zip(event_types, all_events_and_measurements):
+        for event_type, events, measurements in blocks:
             try:
                 new_events = cls._inc_df_col(events, "event_id", running_event_id_max)
             except Exception as e:
@@ -296,6 +375,89 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
             running_event_id_max = int(all_events[-1]["event_id"].max()) + 1
 
         return cls._concat_dfs(all_events), cls._concat_dfs(all_measurements)
+
+    @classmethod
+    def build_event_and_measurement_dfs(
+        cls,
+        subject_ids_map: dict[Any, int],
+        subject_id_col: str,
+        subject_id_dtype: Any,
+        schemas_by_df: dict[Any, list[InputDFSchema]],
+    ) -> tuple[DF_T, DF_T]:
+        """Builds events + measurements dfs from the schema map (``dataset_base.py:202``)."""
+        return cls._merge_event_blocks(
+            cls._iter_source_blocks(subject_ids_map, subject_id_col, subject_id_dtype, schemas_by_df)
+        )
+
+    @classmethod
+    def build_event_and_measurement_dfs_sharded(
+        cls,
+        subject_ids_map: dict[Any, int],
+        subject_id_col: str,
+        subject_id_dtype: Any,
+        schemas_by_df: dict[Any, list[InputDFSchema]],
+        n_workers: int,
+        stream_dir: Path | str,
+    ) -> tuple[DF_T, DF_T]:
+        """Subject-sharded, multi-process `build_event_and_measurement_dfs`.
+
+        The raw subject-id map is partitioned into contiguous shards
+        (`shard_subject_ids`); each worker runs the identical per-source
+        block pipeline on its shard only and STREAMS its per-block outputs
+        to parquet under ``stream_dir`` (worker→parent traffic is a path
+        list, worker RSS is O(shard)). The parent then merges block by
+        block: within a block, every row carries its position in the loaded
+        source df (``__row_pos__``), duplicates can only collide within one
+        subject (rows carry ``subject_id``), and dedup keeps first — so a
+        stable sort on ``__row_pos__`` reproduces the serial block row
+        order exactly, and the serial event-id assignment follows. The
+        merged frames are bit-identical to the single-process path (pinned
+        by test).
+        """
+        shards = shard_subject_ids(subject_ids_map, n_workers)
+        if len(shards) <= 1:
+            return cls.build_event_and_measurement_dfs(
+                subject_ids_map, subject_id_col, subject_id_dtype, schemas_by_df
+            )
+
+        import shutil
+
+        stream_dir = Path(stream_dir)
+        stream_dir.mkdir(parents=True, exist_ok=True)
+        try:
+            payload = (cls, shards, subject_id_col, subject_id_dtype, schemas_by_df, stream_dir)
+            manifests = _fork_map(
+                payload, _etl_build_shard_worker, list(range(len(shards))), n_workers
+            )
+            manifests = [m for _, m in sorted(manifests, key=lambda wm: wm[0])]
+
+            n_blocks = len(manifests[0])
+
+            def merged_blocks():
+                for b in range(n_blocks):
+                    event_type = manifests[0][b][0]
+                    ev_parts = [cls._read_df(Path(m[b][1])) for m in manifests]
+                    # pandas used directly for the order-restoring merge: the
+                    # shard files are the backend's own parquet, and the base
+                    # class already leans on pandas for the DL shard concat.
+                    events = pd.concat(ev_parts, ignore_index=True)
+                    events = events.sort_values("__row_pos__", kind="stable").reset_index(drop=True)
+                    events["event_id"] = np.arange(len(events), dtype=np.int64)
+                    meas = None
+                    if manifests[0][b][2] is not None:
+                        me_parts = [cls._read_df(Path(m[b][2])) for m in manifests]
+                        meas = pd.concat(me_parts, ignore_index=True)
+                        meas = meas.sort_values("__row_pos__", kind="stable").reset_index(drop=True)
+                        meas["event_id"] = events["event_id"].to_numpy()
+                        meas = meas.drop(columns=["__row_pos__"])
+                    yield event_type, events.drop(columns=["__row_pos__"]), meas
+
+            return cls._merge_event_blocks(merged_blocks())
+        finally:
+            # The whole directory is ours (a dedicated .etl_shards/ or
+            # tempdir): multi-GB shard files must not outlive the merge,
+            # successful or not.
+            shutil.rmtree(stream_dir, ignore_errors=True)
 
     @classmethod
     def _get_preprocessing_model(cls, model_config: dict[str, Any], for_fit: bool = False):
@@ -353,6 +515,17 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         obj = cls.__new__(cls)
         for k, v in attrs.items():
             setattr(obj, k, v)
+        # Incremental-fit sidecars (absent on legacy caches).
+        if not hasattr(obj, "_frozen_vocab"):
+            obj._frozen_vocab = None
+        if not hasattr(obj, "_raw_subject_key_map"):
+            obj._raw_subject_key_map = None
+        stats_fp = load_dir / "preprocessor_sufficient_stats.json"
+        if stats_fp.is_file():
+            with open(stats_fp) as f:
+                obj._preproc_stats = json.load(f)
+        else:
+            obj._preproc_stats = None
 
         for attr, fp_fn in (
             ("subjects_df", cls.subjects_fp),
@@ -374,6 +547,7 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         self.config.to_json_file(save_dir / "config.json", do_overwrite=do_overwrite)
 
         if self._is_fit:
+            self._freeze_unified_layout()
             metadata_dir = save_dir / "inferred_measurement_metadata"
             for k, v in self.inferred_measurement_configs.items():
                 v.cache_measurement_metadata(metadata_dir / f"{k}.csv")
@@ -385,12 +559,18 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
                 save_dir / "vocabulary_config.json", do_overwrite=do_overwrite
             )
 
+            if getattr(self, "_preproc_stats", None) is not None:
+                with open(save_dir / "preprocessor_sufficient_stats.json", "w") as f:
+                    json.dump(self._preproc_stats, f)
+
         attrs = {
             "_is_fit": self._is_fit,
             "split_subjects": self.split_subjects,
             "subject_ids": self.subject_ids,
             "event_types": self.event_types,
             "n_events_per_subject": self.n_events_per_subject,
+            "_frozen_vocab": getattr(self, "_frozen_vocab", None),
+            "_raw_subject_key_map": getattr(self, "_raw_subject_key_map", None),
         }
         attrs_fp = save_dir / "E.pkl"
         if attrs_fp.exists() and not do_overwrite:
@@ -414,6 +594,7 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         events_df: DF_T | None = None,
         dynamic_measurements_df: DF_T | None = None,
         input_schema: DatasetSchema | None = None,
+        n_workers: int = 1,
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -442,19 +623,51 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
 
             with self._time_as("build_subjects_dfs"):
                 subjects_df, ID_map = self.build_subjects_dfs(input_schema.static)
+            # Persisted so `append_subjects` can detect a re-submitted raw
+            # subject key instead of silently minting a duplicate subject.
+            self._raw_subject_key_map = dict(ID_map)
             subject_id_dtype = subjects_df["subject_id"].dtype
 
             with self._time_as("build_event_and_measurement_dfs"):
-                events_df, dynamic_measurements_df = self.build_event_and_measurement_dfs(
-                    ID_map,
-                    input_schema.static.subject_id_col,
-                    subject_id_dtype,
-                    input_schema.dynamic_by_df,
-                )
+                if n_workers > 1:
+                    import tempfile
+
+                    stream_root = (
+                        Path(config.save_dir) / ".etl_shards"
+                        if config.save_dir is not None
+                        else Path(tempfile.mkdtemp(prefix="esgpt_etl_shards_"))
+                    )
+                    events_df, dynamic_measurements_df = (
+                        self.build_event_and_measurement_dfs_sharded(
+                            ID_map,
+                            input_schema.static.subject_id_col,
+                            subject_id_dtype,
+                            input_schema.dynamic_by_df,
+                            n_workers=n_workers,
+                            stream_dir=stream_root,
+                        )
+                    )
+                else:
+                    events_df, dynamic_measurements_df = self.build_event_and_measurement_dfs(
+                        ID_map,
+                        input_schema.static.subject_id_col,
+                        subject_id_dtype,
+                        input_schema.dynamic_by_df,
+                    )
 
         self.config = config
         self._is_fit = False
         self.inferred_measurement_configs: dict[str, MeasurementConfig] = {}
+        # Incremental-fit state: per-stage sufficient statistics collected at
+        # fit time, and the unified-vocabulary snapshot frozen at first
+        # save/cache (None until then — the live derivation applies). The
+        # raw-key map exists only when this dataset ingested raw inputs
+        # itself (set above); frames-constructed datasets can't collision-
+        # check appends.
+        self._preproc_stats: dict[str, Any] | None = None
+        self._frozen_vocab: dict[str, Any] | None = None
+        if not hasattr(self, "_raw_subject_key_map"):
+            self._raw_subject_key_map: dict | None = None
 
         self._validate_and_set_initial_properties(subjects_df, events_df, dynamic_measurements_df)
 
@@ -620,10 +833,19 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
             raise ValueError(f"Called get_source_df on temporality type {config.temporality}!")
         return source_attr, source_id, source_df
 
+    def _stash_fit_stats(self, stage: str, measure: str, stats) -> None:
+        """Records per-key sufficient statistics (or vocab totals) gathered
+        during fitting — the persisted state the incremental-fit path merges
+        new shards into (`append_subjects`)."""
+        if self._preproc_stats is None:
+            self._preproc_stats = {"outlier": {}, "normalizer": {}, "vocab_totals": {}}
+        self._preproc_stats[stage][measure] = stats
+
     @TimeableMixin.TimeAs
     def fit_measurements(self):
         """Fits all preprocessing parameters over the train split (``dataset_base.py:819``)."""
         self._is_fit = False
+        self._preproc_stats = {"outlier": {}, "normalizer": {}, "vocab_totals": {}}
 
         for measure, config in self.config.measurement_configs.items():
             if config.is_dropped:
@@ -732,6 +954,265 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         for source_attr, id_col, source_df, updated_cols in results:
             self._update_attr_df(source_attr, id_col, source_df, updated_cols)
 
+    # ------------------------------------------------- incremental ingestion
+    def make_shard_view(
+        self,
+        subjects_df,
+        events_df,
+        dynamic_measurements_df,
+        transform_configs: dict[str, MeasurementConfig] | None = None,
+    ) -> "DatasetBase":
+        """A lightweight dataset over one RAW subject shard, sharing this
+        dataset's config and FROZEN fit state.
+
+        The view runs the exact batch pipeline on its shard — validate →
+        agg-by-time → sort → time-dependent functors → frozen-preprocessor
+        transforms → DL representation — through the same instance methods
+        the full ETL uses. Both `append_subjects` and the online-admission
+        path (`serving.ingest`) are built on it, which is what makes their
+        outputs bit-identical to the batch ETL for the same subject.
+        """
+        if not self._is_fit:
+            raise ValueError("Can't make a shard view of an unfit dataset!")
+        view = type(self).__new__(type(self))
+        view.config = self.config
+        view._is_fit = True
+        view._preproc_stats = None
+        view._frozen_vocab = copy.deepcopy(getattr(self, "_frozen_vocab", None))
+        view.split_subjects = {}
+        view.inferred_measurement_configs = (
+            transform_configs if transform_configs is not None else self._frozen_transform_configs()
+        )
+        view._validate_and_set_initial_properties(subjects_df, events_df, dynamic_measurements_df)
+        return view
+
+    def _update_fit_from_shard(self, shard: "DatasetBase") -> None:
+        """Incremental fit: merges one new shard into the persisted fit state.
+
+        Vocabularies grow APPEND-ONLY (`Vocabulary.extend_with_counts` —
+        existing indices frozen); scaler/outlier params refresh from merged
+        (count, sum, sumsq) sufficient statistics; brand-new vocabulary
+        keys are recorded but not type-inferred (they surface as UNK under
+        the frozen unified layout until the next full re-fit).
+        """
+        stats = getattr(self, "_preproc_stats", None)
+        if stats is None:
+            raise ValueError(
+                "append_subjects requires a cache with persisted sufficient statistics "
+                "(preprocessor_sufficient_stats.json) — re-run fit/save with this version."
+            )
+        for measure, config in self.measurement_configs.items():
+            _, _, source_df = shard._get_source_df(config, do_only_train=False)
+            if measure not in source_df:
+                continue
+            source_df = self._filter_col_inclusion(source_df, {measure: True})
+            if len(source_df) == 0:
+                continue
+
+            if config.is_numeric:
+                self._incremental_update_numeric_fit(measure, config, source_df, stats)
+
+            if config.vocabulary is not None:
+                obs = shard._vocab_observations(measure, config, source_df)
+                if obs is not None and len(obs):
+                    counts = obs.value_counts()
+                    prior_total = stats.setdefault("vocab_totals", {}).get(measure)
+                    if prior_total is None:
+                        # A fit-time vocabulary always stashed its total; the
+                        # only current-version way here is a PRESET vocabulary
+                        # (no observed total exists). Skip growth — the frozen
+                        # transform parks unseen elements as UNK regardless.
+                        print(
+                            f"WARNING: no persisted vocabulary totals for {measure!r} "
+                            "(preset vocabulary?); skipping append-only growth."
+                        )
+                        continue
+                    # Raw elements, NOT str(k): vocabularies may hold
+                    # non-string elements (e.g. booleans) and a stringified
+                    # key would miss the idxmap and duplicate the element.
+                    config.vocabulary.extend_with_counts(
+                        {k: int(c) for k, c in counts.items()}, prior_total
+                    )
+                    stats["vocab_totals"][measure] = int(prior_total + int(counts.sum()))
+
+    def append_subjects(
+        self,
+        input_schema: DatasetSchema,
+        split: str = "train",
+        n_workers: int = 1,
+        subjects_per_output_file: int | None = None,
+        do_save: bool = True,
+    ) -> dict[str, Any]:
+        """Appends new subjects to a fit, cached dataset WITHOUT a full re-fit
+        or re-cache.
+
+        Pipeline: ingest the new subjects' raw inputs (optionally
+        subject-sharded over ``n_workers``), run the frozen batch transforms
+        on the new shard only, update the incremental fit state
+        (append-only vocabularies, sufficient-statistic scaler updates),
+        write the new subjects as NEW ``DL_reps/{split}_{chunk}`` files —
+        existing shard files are never touched — and merge the shard into
+        the in-memory frames. Fit state only updates when ``split`` is
+        ``"train"`` (mirroring the train-only full fit).
+
+        ``do_save`` (default True) re-persists the dataset directory at the
+        end (`save(do_overwrite=True)` — sidecars + the three frame
+        parquets; it never touches ``DL_reps/``): without it, a process
+        that exits after append leaves on-disk fit state (grown vocab,
+        merged statistics, the duplicate-subject guard's key map) behind
+        the durable new chunks, and a replayed ingestion job would admit
+        the same batch twice. Pass ``do_save=False`` only to batch several
+        appends under one final `save`.
+
+        Returns ``{"subject_ids", "n_events", "chunk_paths"}``.
+        """
+        if not self._is_fit:
+            raise ValueError("append_subjects requires a fit dataset")
+        if self.config.save_dir is None:
+            raise ValueError("append_subjects requires a save_dir-backed dataset")
+        self._freeze_unified_layout()
+
+        with self._time_as("append_build_subjects"):
+            new_subjects_df, ID_map = self.build_subjects_dfs(input_schema.static)
+            known_keys = getattr(self, "_raw_subject_key_map", None)
+            if known_keys:
+                collisions = sorted(set(ID_map) & set(known_keys))
+                if collisions:
+                    raise ValueError(
+                        f"append_subjects: {len(collisions)} raw subject key(s) already "
+                        f"exist in this dataset (e.g. {collisions[:5]}); re-ingesting a "
+                        "subject would mint a duplicate numeric id. Filter the input or "
+                        "run a full rebuild."
+                    )
+            id_offset = int(max(self.subject_ids)) + 1 if self.subject_ids else 0
+            new_subjects_df = self._inc_df_col(new_subjects_df, "subject_id", id_offset)
+            ID_map = {k: v + id_offset for k, v in ID_map.items()}
+            id_dtype = type(self).get_smallest_valid_int_type(id_offset + len(ID_map))
+            new_subjects_df["subject_id"] = new_subjects_df["subject_id"].astype(id_dtype)
+
+        with self._time_as("append_build_events"):
+            if n_workers > 1:
+                events_df, meas_df = self.build_event_and_measurement_dfs_sharded(
+                    ID_map,
+                    input_schema.static.subject_id_col,
+                    id_dtype,
+                    input_schema.dynamic_by_df,
+                    n_workers=n_workers,
+                    stream_dir=Path(self.config.save_dir) / ".etl_shards",
+                )
+            else:
+                events_df, meas_df = self.build_event_and_measurement_dfs(
+                    ID_map, input_schema.static.subject_id_col, id_dtype,
+                    input_schema.dynamic_by_df,
+                )
+
+        with self._time_as("append_shard_pipeline"):
+            shard = self.make_shard_view(new_subjects_df, events_df, meas_df)
+            shard._filter_subjects()
+            shard._add_time_dependent_measurements()
+
+            if split == "train":
+                self._update_fit_from_shard(shard)
+                # Re-freeze nothing: the layout snapshot pins transforms, but
+                # numeric params just moved — hand the shard fresh configs.
+                shard.inferred_measurement_configs = self._frozen_transform_configs()
+
+            shard.transform_measurements(n_workers=n_workers)
+
+        with self._time_as("append_cache_shard"):
+            DL_dir = Path(self.config.save_dir) / "DL_reps"
+            DL_dir.mkdir(exist_ok=True, parents=True)
+            suffixes = [
+                fp.stem.rpartition("_")[2] for fp in DL_dir.glob(f"*.{self.DF_SAVE_FORMAT}")
+            ]
+            existing = [int(s) for s in suffixes if s.isdigit()]
+            next_chunk = (max(existing) + 1) if existing else 0
+
+            if subjects_per_output_file is None:
+                subject_chunks = [sorted(shard.subject_ids)]
+            else:
+                ids = np.asarray(sorted(shard.subject_ids))
+                subject_chunks = [
+                    list(c)
+                    for c in np.array_split(
+                        ids, max(1, -(-len(ids) // subjects_per_output_file))
+                    )
+                ]
+            chunk_paths = []
+            for i, chunk_ids in enumerate(subject_chunks):
+                rep = shard._build_dl_rep_sharded(list(chunk_ids), n_workers)
+                fp = DL_dir / f"{split}_{next_chunk + i}.{self.DF_SAVE_FORMAT}"
+                self._write_df(rep, fp, do_overwrite=False)
+                chunk_paths.append(fp)
+
+        with self._time_as("append_merge_frames"):
+            self._merge_shard_frames(shard, split)
+            if getattr(self, "_raw_subject_key_map", None) is not None:
+                kept = set(shard.subject_ids)
+                self._raw_subject_key_map.update(
+                    {k: v for k, v in ID_map.items() if v in kept}
+                )
+
+        if do_save:
+            with self._time_as("append_save_metadata"):
+                self.save(do_overwrite=True)
+
+        return {
+            "subject_ids": sorted(shard.subject_ids),
+            "n_events": len(shard.events_df),
+            "chunk_paths": chunk_paths,
+        }
+
+    def _merge_shard_frames(self, shard: "DatasetBase", split: str) -> None:
+        """Merges a transformed shard view's frames and bookkeeping into this
+        dataset: event/measurement ids rebase past the current maxima, the
+        live event-type list grows append-only (frozen snapshot untouched),
+        and the new subjects join ``split``."""
+        ev_offset = int(self.events_df["event_id"].max()) + 1 if len(self.events_df) else 0
+        shard_events = shard.events_df.copy()
+        shard_events["event_id"] = shard_events["event_id"].astype(np.int64) + ev_offset
+        shard_meas = shard.dynamic_measurements_df
+        if shard_meas is not None:
+            shard_meas = shard_meas.copy()
+            shard_meas["event_id"] = shard_meas["event_id"].astype(np.int64) + ev_offset
+            if (
+                self.dynamic_measurements_df is not None
+                and "measurement_id" in shard_meas
+                and "measurement_id" in self.dynamic_measurements_df
+            ):
+                m_offset = (
+                    int(self.dynamic_measurements_df["measurement_id"].max()) + 1
+                    if len(self.dynamic_measurements_df)
+                    else 0
+                )
+                shard_meas["measurement_id"] = (
+                    shard_meas["measurement_id"].astype(np.int64) + m_offset
+                )
+
+        id_dt = type(self).get_smallest_valid_int_type(
+            ev_offset + len(shard_events) + 1
+        )
+        self.events_df = self._concat_dfs(
+            [self.events_df.assign(event_id=self.events_df["event_id"].astype(id_dt)),
+             shard_events.assign(event_id=shard_events["event_id"].astype(id_dt))]
+        )
+        if shard_meas is not None:
+            self.dynamic_measurements_df = self._concat_dfs(
+                [self.dynamic_measurements_df, shard_meas]
+            )
+        self.subjects_df = self._concat_dfs([self.subjects_df, shard.subjects_df])
+
+        # Live event-type growth, append-only: existing order is load-bearing
+        # (the frozen snapshot indexes into it for pre-freeze types).
+        known = set(self.event_types)
+        self.event_types = list(self.event_types) + [
+            et for et in shard.event_types if et not in known
+        ]
+        self.n_events_per_subject.update(shard.n_events_per_subject)
+        self.subject_ids = set(self.subject_ids) | set(shard.subject_ids)
+        self.split_subjects.setdefault(split, set())
+        self.split_subjects[split] |= set(shard.subject_ids)
+
     # ------------------------------------------------------------ properties
     @property
     def has_static_measurements(self):
@@ -765,6 +1246,15 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
     @property
     def measurement_idxmaps(self):
         """Per-measurement vocab idxmaps; event_type first (``dataset_base.py:1043``)."""
+        frozen = getattr(self, "_frozen_vocab", None)
+        if frozen is not None:
+            return {
+                m: {v: i for i, v in enumerate(vocab)}
+                for m, vocab in self.measurement_vocabs.items()
+            }
+        # Unfrozen: reuse each Vocabulary's cached idxmap — these properties
+        # sit on the ETL hot path (melt/vocab-config), so rebuilding every
+        # dict per access would be quadratic in measures x vocab.
         idxmaps = {"event_type": {et: i for i, et in enumerate(self.event_types)}}
         for m, config in self.measurement_configs.items():
             if config.vocabulary is not None:
@@ -773,11 +1263,71 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
 
     @property
     def measurement_vocabs(self):
+        """Per-measurement vocab element lists, event_type first.
+
+        Once the unified layout is frozen (`_freeze_unified_layout` — first
+        save or DL-cache write), this returns the SNAPSHOT: the DL cache
+        stores unified indices, so the layout every downstream consumer
+        derives from here must never move even as the live vocabularies
+        grow append-only under `append_subjects`.
+        """
+        frozen = getattr(self, "_frozen_vocab", None)
+        if frozen is not None:
+            vocabs = {"event_type": list(frozen["event_types"])}
+            for m, v in frozen["measurement_vocabs"].items():
+                vocabs[m] = list(v)
+            return vocabs
         vocabs = {"event_type": self.event_types}
         for m, config in self.measurement_configs.items():
             if config.vocabulary is not None:
                 vocabs[m] = config.vocabulary.vocabulary
         return vocabs
+
+    def _freeze_unified_layout(self) -> None:
+        """Snapshots the unified vocabulary layout (idempotent).
+
+        Called on first save/DL-cache write: from this point the cache on
+        disk references these indices and offsets, so the derived unified
+        properties pin to the snapshot. Live vocabularies keep growing
+        (append-only) for future full re-fits; the frozen view is what
+        transforms, melts, and `vocabulary_config` see.
+        """
+        if getattr(self, "_frozen_vocab", None) is not None or not self._is_fit:
+            return
+        self._frozen_vocab = {
+            "event_types": list(self.event_types),
+            "measurement_vocabs": {
+                m: list(config.vocabulary.vocabulary)
+                for m, config in self.measurement_configs.items()
+                if config.vocabulary is not None
+            },
+        }
+
+    def _frozen_transform_configs(self) -> dict[str, MeasurementConfig]:
+        """Deep-copied measurement configs with vocabularies REBUILT from the
+        frozen snapshot — the transform state for post-freeze shards (append
+        + online admission), so elements appended after the freeze become
+        UNK in the cache exactly as a rare element would.
+
+        Rebuilt, not prefix-truncated: `Vocabulary.__post_init__` re-sorts
+        by frequency on every save/load round trip, so after an append +
+        reload the live element ORDER no longer extends the snapshot — only
+        the snapshot itself is authoritative for the frozen layout. The
+        element set is what the transform consumes; frequencies are carried
+        over per element (advisory only)."""
+        configs = copy.deepcopy(self.measurement_configs)
+        frozen = (getattr(self, "_frozen_vocab", None) or {}).get("measurement_vocabs", {})
+        for m, cfg in configs.items():
+            if cfg.vocabulary is not None and m in frozen:
+                v = cfg.vocabulary
+                live_idx = v.idxmap
+                v.vocabulary = list(frozen[m])
+                v.obs_frequencies = [
+                    v.obs_frequencies[live_idx[el]] if el in live_idx else 0.0
+                    for el in v.vocabulary
+                ]
+                v.__dict__.pop("idxmap", None)
+        return configs
 
     @property
     def unified_measurements_vocab(self) -> list[str]:
@@ -801,9 +1351,10 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
     @property
     def unified_vocabulary_idxmap(self) -> dict[str, dict[str, int]]:
         idxmaps = {}
+        meas_idxmaps = self.measurement_idxmaps  # bound once: property rebuilds
         for m, offset in self.unified_vocabulary_offsets.items():
-            if m in self.measurement_idxmaps:
-                idxmaps[m] = {v: i + offset for v, i in self.measurement_idxmaps[m].items()}
+            if m in meas_idxmaps:
+                idxmaps[m] = {v: i + offset for v, i in meas_idxmaps[m].items()}
             else:
                 idxmaps[m] = {m: offset}
         return idxmaps
@@ -869,6 +1420,7 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         byte — tested). The reference gets the equivalent parallelism from
         Polars' Rust threadpool (``dataset_polars.py:643``).
         """
+        self._freeze_unified_layout()
         DL_dir = Path(self.config.save_dir) / "DL_reps"
         DL_dir.mkdir(exist_ok=True, parents=True)
 
@@ -893,17 +1445,31 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
 
     def _build_dl_rep_sharded(self, subjects_list, n_workers: int):
         """`build_DL_cached_representation`, optionally subject-sharded over
-        a process pool with a deterministic sorted-shard merge."""
+        a process pool with a deterministic sorted-shard merge.
+
+        Shard outputs STREAM through per-shard parquet files rather than the
+        result pipe: each worker writes its frame to disk and returns only
+        the path, so worker RSS is O(shard) and no multi-GB frame is ever
+        pickled. The parent reads the shards back in order; the serial
+        output is subject-id-sorted (np.unique grouping + sorted outer
+        merge), so consecutive shards of the sorted id list concat to the
+        identical frame (pinned by test)."""
         if n_workers <= 1:
             return self.build_DL_cached_representation(subject_ids=subjects_list)
-        import pandas as pd
+        import shutil
+        import tempfile
 
         ids = sorted(subjects_list if subjects_list is not None else list(self.subject_ids))
         if len(ids) < 2 * n_workers:
             return self.build_DL_cached_representation(subject_ids=subjects_list)
-        # The serial output is subject-id-sorted (np.unique grouping + sorted
-        # outer merge), so consecutive shards of the sorted id list concat to
-        # the identical frame.
         shards = [list(s) for s in np.array_split(np.asarray(ids), n_workers)]
-        dfs = _fork_map(self, _dl_rep_shard_worker, shards, n_workers)
-        return pd.concat(dfs, ignore_index=True)
+        stream_dir = Path(tempfile.mkdtemp(prefix="esgpt_dl_shards_"))
+        try:
+            tasks = [
+                (shard, stream_dir / f"dl_shard_{i}.{self.DF_SAVE_FORMAT}")
+                for i, shard in enumerate(shards)
+            ]
+            paths = _fork_map(self, _dl_rep_shard_to_disk_worker, tasks, n_workers)
+            return pd.concat([self._read_df(Path(fp)) for fp in paths], ignore_index=True)
+        finally:
+            shutil.rmtree(stream_dir, ignore_errors=True)

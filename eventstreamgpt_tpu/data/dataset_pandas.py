@@ -122,6 +122,7 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
         subject_id_dtype: Any | None = None,
         filter_on: dict[str, bool | list[Any]] | None = None,
         subject_id_source_col: str | None = None,
+        keep_row_pos: bool = False,
     ):
         """Loads + type-coerces an input df (reference ``dataset_polars.py:147``)."""
         if subject_id_col is None:
@@ -152,6 +153,13 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
             )
         else:
             raise TypeError(f"Input dataframe `df` is of invalid type {type(df)}!")
+
+        if keep_row_pos:
+            # Positions are row order in the loaded source; normalizing the
+            # index makes the labels that survive filtering be exactly those
+            # positions, identically for every subject shard of the same
+            # source.
+            df = df.reset_index(drop=True)
 
         if filter_on:
             df = cls._filter_col_inclusion(df, filter_on)
@@ -189,6 +197,9 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
                 out[in_col] = pd.to_datetime(col)
             else:
                 raise ValueError(f"Invalid out data type {out_dt}!")
+
+        if keep_row_pos:
+            out["__row_pos__"] = out.index.to_numpy(dtype=np.int64)
 
         if subject_id_source_col is not None:
             return out.reset_index(drop=True), ID_map
@@ -231,13 +242,21 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
         df = df.rename(columns=rename)
         data_cols = [c for c in dict.fromkeys(rename.values()) if c in df.columns]
 
-        df = df[keep_cols + data_cols].drop_duplicates().reset_index(drop=True)
+        # The sharded build threads a per-row position marker through; it
+        # must ride along but never participate in dedup (its uniqueness
+        # would defeat it), so dedup always runs on the serial column set.
+        marker = ["__row_pos__"] if "__row_pos__" in df.columns else []
+        df = (
+            df[keep_cols + data_cols + marker]
+            .drop_duplicates(subset=keep_cols + data_cols)
+            .reset_index(drop=True)
+        )
         df["event_id"] = np.arange(len(df), dtype=np.int64)
 
-        events_df = df[["event_id", "subject_id", "timestamp", "event_type"]]
+        events_df = df[["event_id", "subject_id", "timestamp", "event_type"] + marker]
 
         if data_cols:
-            dynamic_measurements_df = df[["event_id"] + data_cols]
+            dynamic_measurements_df = df[["event_id"] + data_cols + marker]
         else:
             dynamic_measurements_df = None
 
@@ -714,6 +733,11 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
         if self.config.outlier_detector_config is not None:
             M = self._get_preprocessing_model(self.config.outlier_detector_config, for_fit=True)
             params = M.fit_grouped(work[val_col], work[key_col])
+            # Sufficient statistics over the SAME rows the fit saw — the
+            # persisted state `append_subjects` merges new shards into.
+            self._stash_fit_stats(
+                "outlier", measure, M.sufficient_stats_grouped(work[val_col], work[key_col])
+            )
             if "outlier_model" not in metadata.columns:
                 metadata["outlier_model"] = None
             metadata["outlier_model"] = metadata["outlier_model"].astype(object)
@@ -734,6 +758,9 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
         if self.config.normalizer_config is not None:
             M = self._get_preprocessing_model(self.config.normalizer_config, for_fit=True)
             params = M.fit_grouped(work[val_col], work[key_col])
+            self._stash_fit_stats(
+                "normalizer", measure, M.sufficient_stats_grouped(work[val_col], work[key_col])
+            )
             if "normalizer" not in metadata.columns:
                 metadata["normalizer"] = None
             metadata["normalizer"] = metadata["normalizer"].astype(object)
@@ -748,8 +775,11 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
             return metadata.loc[measure]
         return metadata
 
-    def _fit_vocabulary(self, measure, config, source_df) -> Vocabulary | None:
-        """Reference ``dataset_polars.py:1038``."""
+    def _vocab_observations(self, measure, config, source_df) -> pd.Series | None:
+        """The vocabulary observation series for one measure — the shared
+        naming logic (``__EQ_`` re-keying for categorical numerics) used by
+        the from-scratch fit AND the incremental append path, so both count
+        the exact same elements."""
         if config.modality == DataModality.MULTIVARIATE_REGRESSION:
             md = config.measurement_metadata
             value_types = md["value_type"]
@@ -778,12 +808,17 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
         else:
             observations = source_df[measure]
 
-        observations = observations.dropna()
-        if len(observations) == 0:
+        return observations.dropna()
+
+    def _fit_vocabulary(self, measure, config, source_df) -> Vocabulary | None:
+        """Reference ``dataset_polars.py:1038``."""
+        observations = self._vocab_observations(measure, config, source_df)
+        if observations is None or len(observations) == 0:
             return None
 
         if config.vocabulary is None:
             value_counts = observations.value_counts()
+            self._stash_fit_stats("vocab_totals", measure, int(value_counts.sum()))
             try:
                 return Vocabulary(
                     vocabulary=value_counts.index.tolist(),
@@ -792,6 +827,92 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
             except AssertionError as e:
                 raise AssertionError(f"Failed to build vocabulary for {measure}") from e
         return None
+
+    def _incremental_update_numeric_fit(self, measure, config, source_df, stats_store) -> None:
+        """Merges one new shard's observations into the persisted sufficient
+        statistics and refreshes outlier/normalizer params for keys that
+        received new data (`append_subjects` leg 2).
+
+        Frozen-fit semantics, by design:
+        * value types of fitted keys NEVER change (an int key stays int);
+        * brand-new keys are NOT type-inferred or fitted — they surface as
+          UNK under the frozen unified layout until the next full re-fit;
+        * params for updated keys come from `params_from_stats` on the
+          merged (count, sum, sumsq) — mean/std may drift last-ulp from a
+          from-scratch re-fit on the concatenated data (documented + pinned
+          by the append drift test);
+        * the new shard's outlier filtering uses the UPDATED thresholds
+          (old observations were filtered with the thresholds of their own
+          era — the stats sidecar records what each era actually saw).
+        """
+        metadata, key_col, val_col = self._metadata_as_df(measure, config)
+        if "value_type" not in metadata.columns:
+            return
+
+        if config.modality == DataModality.UNIVARIATE_REGRESSION:
+            work = source_df[[measure]].copy()
+            work[key_col] = measure
+        else:
+            work = source_df[[measure, val_col]].copy()
+        work = work[work[key_col].notna() & work[val_col].notna()]
+        if len(work) == 0:
+            return
+
+        # Pre-set bound-based drop/censor — identical to the full fit.
+        bound_cols_present = [c for c in BOUND_COLS if c in metadata.columns]
+        if bound_cols_present:
+            joined = work.join(metadata[bound_cols_present], on=key_col)
+            bounds = {c: joined[c].to_numpy() for c in bound_cols_present}
+            work = work.assign(**{val_col: self.drop_or_censor_np(joined[val_col].to_numpy(), bounds)})
+        work = work[work[val_col].notna()]
+
+        # Frozen value types: round INTEGER keys, keep INTEGER/FLOAT rows.
+        work = work.join(metadata["value_type"].rename("_vt"), on=key_col)
+        int_mask = work["_vt"] == NumericDataModalitySubtype.INTEGER
+        float_mask = work["_vt"] == NumericDataModalitySubtype.FLOAT
+        work = work.assign(**{val_col: work[val_col].round(0).where(int_mask, work[val_col])})
+        work = work[int_mask | float_mask]
+        work = work[work[val_col].notna()]
+        if len(work) == 0:
+            return
+
+        def merge_and_refresh(stage: str, model_cfg: dict, param_col: str):
+            M = self._get_preprocessing_model(model_cfg, for_fit=True)
+            new_stats = M.sufficient_stats_grouped(work[val_col], work[key_col])
+            stage_store = stats_store.setdefault(stage, {}).setdefault(measure, {})
+            if param_col not in metadata.columns:
+                metadata[param_col] = None
+            metadata[param_col] = metadata[param_col].astype(object)
+            for k, s in new_stats.items():
+                merged = M.merge_stats(stage_store.get(str(k)), s)
+                stage_store[str(k)] = merged
+                metadata.at[k, param_col] = M.params_from_stats(merged)
+            return M
+
+        if self.config.outlier_detector_config is not None:
+            M = merge_and_refresh("outlier", self.config.outlier_detector_config, "outlier_model")
+            om = work.join(metadata["outlier_model"].rename("_om"), on=key_col)["_om"]
+            per_row = {
+                f: np.asarray(
+                    [p[f] if isinstance(p, dict) else np.nan for p in om], dtype=np.float64
+                )
+                for f in M.params_schema()
+            }
+            with np.errstate(invalid="ignore"):
+                is_outlier = M.predict(work[val_col].to_numpy(), per_row)
+            work = work[~is_outlier]
+
+        if self.config.normalizer_config is not None and len(work):
+            merge_and_refresh("normalizer", self.config.normalizer_config, "normalizer")
+
+        metadata = metadata.drop(columns=["_vt"], errors="ignore")
+        metadata.index.name = (
+            key_col if config.modality == DataModality.UNIVARIATE_REGRESSION else measure
+        )
+        if config.modality == DataModality.UNIVARIATE_REGRESSION:
+            config.measurement_metadata = metadata.loc[measure]
+        else:
+            config.measurement_metadata = metadata
 
     def _transform_numerical_measurement(self, measure, config, source_df) -> DF_T:
         """Reference ``dataset_polars.py:1100-1196``."""

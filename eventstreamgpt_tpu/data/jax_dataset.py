@@ -227,7 +227,15 @@ class JaxDataset(SeedableMixin, TimeableMixin):
     # ------------------------------------------------------------------ I/O
     @staticmethod
     def _read_dl_reps(dl_dir: Path, split: str) -> pd.DataFrame:
-        files = sorted(Path(dl_dir).glob(f"{split}*.parquet"))
+        # Chunk order is load-bearing (subject order feeds the deterministic
+        # batch stream); `append_subjects` grows chunk counts past 9, where
+        # lexicographic sorting would interleave ("x_10" < "x_2") and shuffle
+        # subjects between runs — so order numerically by the chunk suffix.
+        def chunk_key(fp: Path):
+            stem, _, suffix = fp.stem.rpartition("_")
+            return (stem, int(suffix)) if suffix.isdigit() else (fp.stem, -1)
+
+        files = sorted(Path(dl_dir).glob(f"{split}*.parquet"), key=chunk_key)
         if not files:
             raise FileNotFoundError(f"No DL_reps parquet files for split {split} in {dl_dir}")
         return pd.concat([pd.read_parquet(fp) for fp in files], ignore_index=True)

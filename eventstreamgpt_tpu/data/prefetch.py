@@ -55,6 +55,10 @@ class DevicePrefetcher:
         # failed construction still destructs cleanly via __del__.
         self._stop = threading.Event()
         self._thread = None
+        # Streaming sources (e.g. the sharded-ETL feed) may expose close();
+        # held so close() can tell a stalled source to stop producing
+        # instead of abandoning the worker mid-`__next__` every time.
+        self._source = batches
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1; got {depth}")
         self._queue: queue.Queue = queue.Queue(maxsize=depth)
@@ -111,6 +115,20 @@ class DevicePrefetcher:
         self._stop.set()
         if getattr(self, "_queue", None) is None:
             return
+        # A streaming source with its own lifecycle (shard workers, file
+        # handles) gets told to stop FIRST: a worker blocked inside the
+        # source's __next__ can't see the stop flag, so without this the
+        # bounded join below would always burn its full timeout on a
+        # stalled shard. Generators refuse cross-thread close() while
+        # executing — that (or any source-side failure) must not break
+        # teardown, so errors are swallowed and the bounded join still
+        # guarantees close() returns.
+        src_close = getattr(getattr(self, "_source", None), "close", None)
+        if src_close is not None:
+            try:
+                src_close()
+            except Exception:
+                pass
         # Drain so a blocked worker put() can observe the stop flag.
         try:
             while True:

@@ -54,6 +54,105 @@ class Preprocessor(ABC):
             {k: self.fit(g.to_numpy()) for k, g in values.groupby(keys)}, dtype=object
         )
 
+    # ------------------------------------------------- incremental-fit API
+    # The streaming/append path (``Dataset.append_subjects``) never re-reads
+    # old observations: each fit persists (count, sum, sum-of-squares) per
+    # vocabulary key in the cache metadata, new shards contribute their own
+    # stats, and the merged params come from `params_from_stats`. Any
+    # moment-based preprocessor gets this for free; a plugin whose params
+    # are not derivable from these moments must override all three hooks.
+
+    @staticmethod
+    def sufficient_stats(column) -> dict[str, float]:
+        """(count, sum, sum-of-squares) of one key's raw observations."""
+        column = np.asarray(column, dtype=np.float64)
+        return {
+            "count": int(len(column)),
+            "sum": float(np.sum(column)),
+            "sumsq": float(np.sum(column * column)),
+        }
+
+    @classmethod
+    def sufficient_stats_grouped(cls, values, keys) -> dict[str, dict[str, float]]:
+        """Per-key sufficient statistics in one grouped aggregation.
+
+        Keys are STRINGIFIED: the stats persist through a JSON sidecar
+        (whose object keys are strings), so normalizing here keeps the
+        in-session and round-tripped spellings identical.
+
+        Examples:
+            >>> import pandas as pd
+            >>> class P(Preprocessor):
+            ...     @classmethod
+            ...     def params_schema(cls): return {}
+            ...     def fit(self, column): return {}
+            ...     @classmethod
+            ...     def predict(cls, column, model_params): return column
+            >>> P.sufficient_stats_grouped(
+            ...     pd.Series([1., 2., 4.]), pd.Series(list("aab")))
+            {'a': {'count': 2, 'sum': 3.0, 'sumsq': 5.0}, 'b': {'count': 1, 'sum': 4.0, 'sumsq': 16.0}}
+        """
+        import pandas as pd
+
+        vals = values.astype(np.float64)
+        agg = pd.DataFrame({"v": vals, "v2": vals * vals}).groupby(keys.to_numpy()).agg(
+            count=("v", "size"), sum=("v", "sum"), sumsq=("v2", "sum")
+        )
+        return {
+            str(k): {
+                "count": int(r["count"]),
+                "sum": float(r["sum"]),
+                "sumsq": float(r["sumsq"]),
+            }
+            for k, r in agg.iterrows()
+        }
+
+    @staticmethod
+    def merge_stats(a: dict[str, float] | None, b: dict[str, float] | None) -> dict[str, float]:
+        """Adds two sufficient-statistic structs (either side may be None).
+
+        Examples:
+            >>> Preprocessor.merge_stats(
+            ...     {"count": 2, "sum": 3.0, "sumsq": 5.0},
+            ...     {"count": 1, "sum": 4.0, "sumsq": 16.0})
+            {'count': 3, 'sum': 7.0, 'sumsq': 21.0}
+        """
+        if a is None:
+            return dict(b)
+        if b is None:
+            return dict(a)
+        return {
+            "count": int(a["count"]) + int(b["count"]),
+            "sum": float(a["sum"]) + float(b["sum"]),
+            "sumsq": float(a["sumsq"]) + float(b["sumsq"]),
+        }
+
+    @staticmethod
+    def _moments_from_stats(stats: dict[str, float]) -> tuple[float, float]:
+        """(mean, sample std ddof=1) from sufficient statistics; the std is
+        NaN for fewer than two observations, matching ``fit``'s convention."""
+        n = int(stats["count"])
+        if n == 0:
+            return float("nan"), float("nan")
+        mean = stats["sum"] / n
+        if n < 2:
+            return mean, float("nan")
+        var = max(stats["sumsq"] - n * mean * mean, 0.0) / (n - 1)
+        return mean, float(np.sqrt(var))
+
+    def params_from_stats(self, stats: dict[str, float]) -> dict[str, float]:
+        """Fit params derived from (merged) sufficient statistics.
+
+        NOTE: floating-point accumulation differs from a direct re-fit on
+        the concatenated raw data, so incrementally updated params may
+        drift by last-ulp amounts from a from-scratch fit (documented,
+        pinned by the append-subjects drift test).
+        """
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support incremental fitting from "
+            "sufficient statistics"
+        )
+
     @classmethod
     @abstractmethod
     def predict(cls, column: np.ndarray, model_params: dict[str, np.ndarray]) -> np.ndarray:

@@ -57,6 +57,20 @@ class StandardScaler(Preprocessor):
         agg.columns = ["mean_", "std_"]
         return pd.Series(agg.to_dict("index"), dtype=object).reindex(agg.index)
 
+    def params_from_stats(self, stats: dict[str, float]) -> dict[str, float]:
+        """Scaler params from (merged) sufficient statistics.
+
+        Examples:
+            >>> S = StandardScaler()
+            >>> a = S.sufficient_stats([1., 2., 3.])
+            >>> b = S.sufficient_stats([4., 5.])
+            >>> p = S.params_from_stats(S.merge_stats(a, b))
+            >>> p["mean_"], round(p["std_"], 6)
+            (3.0, 1.581139)
+        """
+        mean, std = self._moments_from_stats(stats)
+        return {"mean_": mean, "std_": std}
+
     @classmethod
     def predict(cls, column: np.ndarray, model_params: dict[str, np.ndarray]) -> np.ndarray:
         return (np.asarray(column, dtype=np.float64) - model_params["mean_"]) / model_params["std_"]

@@ -65,6 +65,22 @@ class StddevCutoffOutlierDetector(Preprocessor):
         )
         return pd.Series(out.to_dict("index"), dtype=object).reindex(out.index)
 
+    def params_from_stats(self, stats: dict[str, float]) -> dict[str, float]:
+        """Thresholds from (merged) sufficient statistics.
+
+        Examples:
+            >>> S = StddevCutoffOutlierDetector(stddev_cutoff=1.0)
+            >>> p = S.params_from_stats(S.sufficient_stats([1., 3.]))
+            >>> p == {"thresh_large_": 2.0 + 1.4142135623730951,
+            ...       "thresh_small_": 2.0 - 1.4142135623730951}
+            True
+        """
+        mean, std = self._moments_from_stats(stats)
+        return {
+            "thresh_large_": mean + self.stddev_cutoff * std,
+            "thresh_small_": mean - self.stddev_cutoff * std,
+        }
+
     @classmethod
     def predict(cls, column: np.ndarray, model_params: dict[str, np.ndarray]) -> np.ndarray:
         column = np.asarray(column, dtype=np.float64)

@@ -128,6 +128,53 @@ class Vocabulary(Generic[VOCAB_ELEMENT]):
             and (np.array(self.obs_frequencies).round(3) == np.array(other.obs_frequencies).round(3)).all()
         )
 
+    def extend_with_counts(
+        self, counts: dict[VOCAB_ELEMENT, int], prior_total: int
+    ) -> list[str]:
+        """Append-only vocabulary growth for the incremental-fit path.
+
+        EXISTING INDICES ARE FROZEN: no element moves, whatever the merged
+        frequencies say (the DL cache stores indices; re-sorting would
+        silently corrupt every cached row). Unseen elements are appended
+        AFTER the current vocabulary, ordered by (count desc, element desc)
+        — the same tie-break rule the from-scratch fit uses within its
+        frequency sort. ``prior_total`` is the observation count behind the
+        current ``obs_frequencies`` (persisted in the cache's
+        sufficient-statistics sidecar) so the merged frequencies stay
+        honest. Returns the appended elements in index order.
+
+        Examples:
+            >>> v = Vocabulary(vocabulary=["apple", "banana", "UNK"], obs_frequencies=[3, 5, 2])
+            >>> v.vocabulary
+            ['UNK', 'banana', 'apple']
+            >>> v.extend_with_counts({"pear": 40, "banana": 10}, prior_total=10)
+            ['pear']
+            >>> v.vocabulary  # banana gained mass but kept its index
+            ['UNK', 'banana', 'apple', 'pear']
+            >>> [round(f, 3) for f in v.obs_frequencies]
+            [0.033, 0.25, 0.05, 0.667]
+        """
+        counts = {k: int(c) for k, c in counts.items() if c}
+        merged = np.asarray(self.obs_frequencies, dtype=float) * float(prior_total)
+        idxmap = self.idxmap
+        new_elements: list = []
+        for el, c in counts.items():
+            if el in idxmap:
+                merged[idxmap[el]] += c
+            else:
+                new_elements.append(el)
+        new_elements.sort(key=lambda el: (counts[el], str(el)), reverse=True)
+
+        self.vocabulary = list(self.vocabulary) + new_elements
+        merged = np.concatenate(
+            [merged, np.asarray([counts[el] for el in new_elements], dtype=float)]
+        )
+        total = merged.sum()
+        self.obs_frequencies = (merged / total if total > 0 else merged).tolist()
+        self.element_types |= {type(el) for el in new_elements if el != "UNK"}
+        self.__dict__.pop("idxmap", None)
+        return new_elements
+
     def filter(self, total_observations: int | None, min_valid_element_freq: COUNT_OR_PROPORTION) -> None:
         """Drops elements rarer than the cutoff, folding their mass into UNK.
 

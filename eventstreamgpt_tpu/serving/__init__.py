@@ -1,6 +1,7 @@
 """Serving: the continuous-batching engine, and the online service over it."""
 
 from .engine import GenerationEngine, SlotState  # noqa: F401
+from .ingest import IngestedSubject, OnlineIngester  # noqa: F401
 from .scheduler import (  # noqa: F401
     AdmissionRejected,
     AdmissionGroup,

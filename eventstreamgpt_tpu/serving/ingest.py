@@ -1,0 +1,279 @@
+"""Online admission: raw per-subject event streams → engine prefill requests.
+
+The last gap in the ingest→engine loop (ROADMAP item 3): before this module,
+a new subject could only reach the serving engine by running the FULL batch
+ETL (build → fit → transform → DL cache → JaxDataset), i.e. minutes of
+latency and a dataset rebuild for one subject. `OnlineIngester` closes the
+loop using the dataset's own frozen fit state:
+
+1. the raw inputs load through the exact batch ingestion code
+   (``DatasetBase.build_subjects_dfs`` / ``build_event_and_measurement_dfs``),
+2. a **shard view** (``DatasetBase.make_shard_view``) runs the identical
+   per-shard pipeline — validate → agg-by-time → sort → time-dependent
+   functors → frozen-preprocessor transforms → DL representation — that
+   `append_subjects` and the batch cache writer use, so the transform output
+   is bit-identical to what the batch ETL produces for the same subject
+   (pinned by test), and
+3. each subject's DL row collates into a one-row `EventStreamBatch` prompt
+   (the `JaxDataset.collate` layout) wrapped in a `scheduler.Request` ready
+   for `GenerationEngine.submit` / `ServingService`.
+
+Everything here is host-side numpy/pandas: the online-admission transform
+never enters a traced scope (graftcheck-gated), and the engine sees requests
+indistinguishable from batch-pipeline prompts.
+
+Vocabulary semantics are the frozen-layout contract (docs/ingestion.md):
+MEASURE elements unseen at freeze time map to UNK exactly as a filtered rare
+element would, so a checkpoint trained on the frozen layout can serve the
+stream without re-fitting. Event TYPES are the exception — the event-type
+vocabulary has no UNK (reference design), so an event whose type was never
+seen at fit time keeps its time and measures but carries no event-type
+element in the prompt.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..data.config import DatasetSchema
+from ..data.types import EventStreamBatch
+from .scheduler import Request
+
+__all__ = ["IngestedSubject", "OnlineIngester"]
+
+
+@dataclasses.dataclass
+class IngestedSubject:
+    """One admitted subject: its raw key, transformed DL row, and prompt."""
+
+    subject_key: Any
+    subject_id: int
+    dl_row: pd.Series
+    prompt: EventStreamBatch
+    n_events: int
+    n_clipped_observations: int = 0
+
+
+class OnlineIngester:
+    """Converts raw event streams into engine prefill requests with the
+    frozen preprocessors of a fit dataset.
+
+    Args:
+        dataset: a fit (and typically cached) `Dataset`; its frozen unified
+            layout and fitted preprocessors drive every transform.
+        max_n_dynamic: data-element width ``M`` of the produced prompts —
+            must match the serving engine's template (events carrying more
+            observations are clipped, counted per subject).
+        max_n_static: static-element width ``S`` (default 1); ``None``
+            omits the static fields entirely — required when the serving
+            template itself carries none, or the prompt pytree structure
+            would mismatch the engine's slot state at admission.
+        max_prompt_events: keep only the LAST this-many events of each
+            subject (generation conditions on recent history; the engine's
+            ``max_prompt_len`` is the usual bound).
+        do_include_start_time: emit ``start_time`` (minutes since epoch) —
+            the batch-pipeline convention for generation prompts.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        *,
+        max_n_dynamic: int,
+        max_n_static: int | None = 1,
+        max_prompt_events: int | None = None,
+        do_include_start_time: bool = True,
+    ):
+        if not dataset._is_fit:
+            raise ValueError("OnlineIngester requires a fit dataset")
+        dataset._freeze_unified_layout()
+        self.dataset = dataset
+        self.max_n_dynamic = int(max_n_dynamic)
+        self.max_n_static = None if max_n_static is None else int(max_n_static)
+        self.max_prompt_events = None if max_prompt_events is None else int(max_prompt_events)
+        self.do_include_start_time = bool(do_include_start_time)
+        # Frozen transform configs are immutable for the ingester's life —
+        # built once, shared by every admitted shard.
+        self._transform_configs = dataset._frozen_transform_configs()
+
+    @classmethod
+    def from_cache_dir(cls, save_dir: Path | str, **kwargs) -> "OnlineIngester":
+        """Loads the fit dataset from a processed-cache directory."""
+        from ..data.dataset_pandas import Dataset
+
+        return cls(Dataset.load(Path(save_dir)), **kwargs)
+
+    @classmethod
+    def from_template(cls, dataset, template: EventStreamBatch, **kwargs) -> "OnlineIngester":
+        """Widths copied from a serving template batch (the engine's own).
+
+        A template without static fields pins ``max_n_static=None`` so the
+        produced prompts share the engine slot state's pytree structure.
+        """
+        kwargs.setdefault("max_n_dynamic", int(template.dynamic_indices.shape[-1]))
+        kwargs.setdefault(
+            "max_n_static",
+            None
+            if template.static_indices is None
+            else int(template.static_indices.shape[-1]),
+        )
+        return cls(dataset, **kwargs)
+
+    # ------------------------------------------------------------- transform
+    def transform(self, input_schema: DatasetSchema):
+        """Raw inputs → transformed shard view + DL-representation frame.
+
+        This is the pure per-shard path the batch ETL itself runs; returns
+        ``(shard_view, dl_rep_df, id_map)`` with ``id_map`` mapping each raw
+        subject key to its shard-local numeric id.
+        """
+        ds = self.dataset
+        subjects_df, id_map = type(ds).build_subjects_dfs(input_schema.static)
+        id_dtype = np.dtype(np.int64)
+        events_df, meas_df = type(ds).build_event_and_measurement_dfs(
+            id_map,
+            input_schema.static.subject_id_col,
+            id_dtype,
+            input_schema.dynamic_by_df,
+        )
+        shard = ds.make_shard_view(
+            subjects_df, events_df, meas_df, transform_configs=self._transform_configs
+        )
+        shard._add_time_dependent_measurements()
+        shard.transform_measurements()
+        rep = shard.build_DL_cached_representation()
+        return shard, rep, id_map
+
+    # -------------------------------------------------------------- collation
+    def _collate_row(self, row: pd.Series) -> tuple[EventStreamBatch, int, int]:
+        """One DL-representation row → a one-row prompt batch.
+
+        Mirrors `JaxDataset` semantics: ``time`` (absolute minutes from the
+        subject's start) becomes ``time_delta`` with a filler 1.0 on the
+        final event; the crop keeps the LAST events (recent history);
+        ``start_time`` advances past the crop in minutes since epoch.
+        """
+        times = np.asarray(row["time"], dtype=np.float64)
+        n_total = len(times)
+        if n_total == 0:
+            raise ValueError(f"Subject {row['subject_id']} has no events after the ETL")
+
+        deltas = np.empty(n_total, dtype=np.float32)
+        if n_total > 1:
+            deltas[:-1] = (times[1:] - times[:-1]).astype(np.float32)
+        deltas[-1] = 1.0
+
+        lo = 0
+        if self.max_prompt_events is not None and n_total > self.max_prompt_events:
+            lo = n_total - self.max_prompt_events
+        n = n_total - lo
+
+        M = self.max_n_dynamic
+        dyn_idx = np.zeros((1, n, M), dtype=np.int64)
+        dyn_meas = np.zeros((1, n, M), dtype=np.int64)
+        dyn_vals = np.zeros((1, n, M), dtype=np.float32)
+        vals_mask = np.zeros((1, n, M), dtype=bool)
+        clipped = 0
+        for j in range(n):
+            ev_i = np.asarray(row["dynamic_indices"][lo + j], dtype=np.int64)
+            ev_m = np.asarray(row["dynamic_measurement_indices"][lo + j], dtype=np.int64)
+            ev_v = np.asarray(
+                [np.nan if v is None else v for v in row["dynamic_values"][lo + j]],
+                dtype=np.float32,
+            )
+            k = len(ev_i)
+            if k > M:
+                clipped += k - M
+                ev_i, ev_m, ev_v = ev_i[:M], ev_m[:M], ev_v[:M]
+                k = M
+            obs = ~np.isnan(ev_v)
+            dyn_idx[0, j, :k] = ev_i
+            dyn_meas[0, j, :k] = ev_m
+            dyn_vals[0, j, :k] = np.nan_to_num(ev_v, nan=0.0)
+            vals_mask[0, j, :k] = obs
+
+        out: dict[str, Any] = dict(
+            event_mask=np.ones((1, n), dtype=bool),
+            time_delta=deltas[lo:][None, :],
+            dynamic_indices=dyn_idx,
+            dynamic_measurement_indices=dyn_meas,
+            dynamic_values=dyn_vals,
+            dynamic_values_mask=vals_mask,
+        )
+
+        S = self.max_n_static
+        if S is not None:
+            static_idx = np.zeros((1, S), dtype=np.int64)
+            static_meas = np.zeros((1, S), dtype=np.int64)
+            si = row.get("static_indices")
+            if si is not None and not (np.isscalar(si) and pd.isna(si)):
+                si = np.asarray(si, dtype=np.int64)[:S]
+                sm = np.asarray(row["static_measurement_indices"], dtype=np.int64)[: len(si)]
+                static_idx[0, : len(si)] = si
+                static_meas[0, : len(sm)] = sm
+            out["static_indices"] = static_idx
+            out["static_measurement_indices"] = static_meas
+
+        if self.do_include_start_time:
+            start_min = pd.Timestamp(row["start_time"]).timestamp() / 60.0
+            out["start_time"] = np.asarray(
+                [start_min + float(deltas[:lo].sum())], dtype=np.float32
+            )
+
+        return EventStreamBatch(**out), n, clipped
+
+    # --------------------------------------------------------------- admission
+    def ingest(self, input_schema: DatasetSchema) -> list[IngestedSubject]:
+        """Transforms + collates every subject of the raw inputs, in raw
+        subject-key order."""
+        _, rep, id_map = self.transform(input_schema)
+        rep = rep.set_index("subject_id", drop=False)
+        out = []
+        for raw_key, sid in id_map.items():
+            if sid not in rep.index:
+                continue  # zero surviving events and no static data
+            row = rep.loc[sid]
+            times = row.get("time")
+            if times is None or np.isscalar(times):
+                # Static-only subject: the DL rep's outer merge keeps a row
+                # with scalar-NaN event columns when every event dropped in
+                # the ETL. Nothing to prompt with — skip it, never abort
+                # the rest of the batch.
+                continue
+            prompt, n, clipped = self._collate_row(row)
+            out.append(
+                IngestedSubject(
+                    subject_key=raw_key,
+                    subject_id=int(sid),
+                    dl_row=row,
+                    prompt=prompt,
+                    n_events=n,
+                    n_clipped_observations=clipped,
+                )
+            )
+        return out
+
+    def requests(
+        self,
+        input_schema: DatasetSchema,
+        max_new_events: int,
+        key: Optional[Any] = None,
+        arrival_time: float = 0.0,
+    ) -> list[Request]:
+        """Raw inputs → ready-to-submit engine requests (one per subject;
+        ``request_id`` is the raw subject key)."""
+        return [
+            Request(
+                prompt=sub.prompt,
+                max_new_events=int(max_new_events),
+                key=key,
+                request_id=sub.subject_key,
+                arrival_time=arrival_time,
+            )
+            for sub in self.ingest(input_schema)
+        ]

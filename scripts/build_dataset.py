@@ -392,7 +392,7 @@ def build_dataset(cfg: dict[str, Any]) -> Dataset:
     if config.save_dir is not None:
         Path(config.save_dir).mkdir(parents=True, exist_ok=True)
 
-    ESD = Dataset(config=config, input_schema=dataset_schema)
+    ESD = Dataset(config=config, input_schema=dataset_schema, n_workers=n_workers)
     ESD.split(split, seed=seed)
     ESD.preprocess(n_workers=n_workers)
     ESD.save(do_overwrite=do_overwrite)

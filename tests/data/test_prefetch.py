@@ -107,6 +107,64 @@ class TestDevicePrefetcher:
         with pytest.raises(ValueError, match="depth"):
             DevicePrefetcher([], jax.device_put, depth=0)
 
+    def test_close_bounded_with_stalled_shard_source(self):
+        """r11 streaming-source contract: a slow/raising shard worker must
+        not hang close(). The worker thread is blocked inside the source's
+        __next__ (it cannot see the stop flag), so close() must (a) tell a
+        closeable source to stop, and (b) return within its bounded join
+        either way."""
+        stalled = threading.Event()
+        closed = threading.Event()
+
+        class StalledShardStream:
+            """A streaming source whose next shard never arrives."""
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                stalled.set()
+                # Released only by close() — a stalled shard worker.
+                closed.wait(timeout=30)
+                raise StopIteration
+
+            def close(self):
+                closed.set()
+
+        it = prefetch_to_device(StalledShardStream(), jax.device_put, depth=2)
+        assert stalled.wait(timeout=5)
+        t0 = time.monotonic()
+        it.close(join_timeout=5.0)
+        assert time.monotonic() - t0 < 5.0, "close() burned its full join timeout"
+        assert closed.is_set(), "close() must propagate to the streaming source"
+        deadline = time.monotonic() + 5
+        while it._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not it._thread.is_alive()
+
+    def test_close_bounded_when_source_close_raises(self):
+        """A source whose close() itself fails (e.g. a generator mid-frame
+        raising ValueError) must not break teardown; the bounded join still
+        returns."""
+        entered = threading.Event()
+
+        class BadCloseSource:
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                entered.set()
+                time.sleep(0.05)
+                return {"x": np.zeros(2)}
+
+            def close(self):
+                raise ValueError("already executing")
+
+        it = prefetch_to_device(BadCloseSource(), jax.device_put, depth=2)
+        assert entered.wait(timeout=5)
+        it.close(join_timeout=5.0)  # must not raise
+        assert it._queue.empty()
+
     def test_skip_batches_resume_exact_through_prefetch(self, tmp_path):
         """Prefetched batch N+1.. equals an uninterrupted epoch's batches."""
         import shutil

@@ -110,6 +110,11 @@ class TestBenchTailCapture:
         "kvq_engine_events_per_sec_per_chip",
         "kvq_slots_per_chip_ratio",
         "service_p95_latency_ms",
+        # r11 streaming-ETL A/B verdicts: the parallel host pipeline vs the
+        # single-process r05 baseline on identical work (bit-identical
+        # artifacts pinned in tier-1).
+        "etl_parallel_events_per_sec",
+        "etl_vs_serial_ratio",
         "zeroshot_auroc",
         "value",
     ]

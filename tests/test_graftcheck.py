@@ -1161,3 +1161,49 @@ class TestCommittedMemoryBudgets:
                     f"{label}: {entry['n_donated'] - accounted} donated "
                     "buffer(s) not aliased in the committed census"
                 )
+
+
+# ----------------------------------------------- Tier A: online-ingest path
+class TestIngestPathGate:
+    """The r11 online-admission path is host-side BY DESIGN: the transform
+    must stay off the traced hot path (no jax in the module, no host syncs
+    reachable from traced scopes) and contribute ZERO new baseline entries —
+    the whole point of admitting raw streams through the frozen batch
+    preprocessors is that the engine never sees untraced host work."""
+
+    INGEST_FILES = (
+        "eventstreamgpt_tpu/serving/ingest.py",
+        "eventstreamgpt_tpu/data/dataset_base.py",
+        "eventstreamgpt_tpu/data/dataset_pandas.py",
+    )
+
+    def test_ingest_path_lints_clean_with_zero_baseline_entries(self):
+        baseline = load_baseline(
+            REPO_ROOT / "eventstreamgpt_tpu" / "analysis" / "baseline.json"
+        )
+        for rel in self.INGEST_FILES:
+            findings = lint_paths([REPO_ROOT / rel], REPO_ROOT)
+            new, _ = apply_baseline(findings, baseline)
+            assert new == [], f"{rel} lint findings:\n" + "\n".join(
+                f.render() for f in new
+            )
+            assert not any(k[0] == rel for k in baseline), (
+                f"{rel} must carry zero suppressed baseline entries — the "
+                "ingest path is new code, not legacy"
+            )
+
+    def test_ingest_module_never_imports_jax(self):
+        src = (REPO_ROOT / "eventstreamgpt_tpu" / "serving" / "ingest.py").read_text()
+        import ast as _ast
+
+        for node in _ast.walk(_ast.parse(src)):
+            names = []
+            if isinstance(node, _ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, _ast.ImportFrom) and node.module:
+                names = [node.module]
+            for name in names:
+                assert not name.split(".")[0] in ("jax", "jaxlib"), (
+                    "the online-admission transform must stay host-side; "
+                    f"found import {name!r} in serving/ingest.py"
+                )
