@@ -38,6 +38,11 @@ Sections:
     interleave, interactive/batch SLO lanes — reporting per-class
     ``service_p50/p95_latency_ms``, ``service_vs_engine_p95_ratio``
     against the synchronous engine arm, and ``service_reject_frac``
+  * pod-scale serving fleet (r12; ``serving/fleet.py``): the same Poisson
+    trace through a 2-service consistent-hash router with a fleet-wide
+    hot checkpoint swap armed at the trace midpoint —
+    ``fleet_p95_latency_ms``, ``fleet_vs_service_p95_ratio``, and the
+    zero-downtime scoreboard ``swap_dropped_requests`` (must be 0)
   * r09 kernel-round levers, each with its own A/B on identical work
     (parity gated in tier-1, speed decided here): the hand-tiled Pallas
     dep-graph attention kernel vs the r06 fused-XLA formulation
@@ -941,6 +946,84 @@ def main():
     service_p95 = svc_q["overall"]["p95_ms"]
     engine.dispatch_depth = 1  # leave the shared engine as the sync arm built it
 
+    # ---- pod-scale serving fleet (r12; serving/fleet.py): the SAME Poisson
+    # trace through a 2-service router with consistent-hash session
+    # affinity (each service one hot-swap replica), plus a fleet-wide
+    # checkpoint promotion armed at the trace midpoint — the zero-downtime
+    # swap under live traffic. Promotion target is the SAME checkpoint, so
+    # the swap's scheduling cost (drain + hold + flip + release) lands in
+    # the latency distribution while outputs stay comparable; the
+    # scoreboard key is swap_dropped_requests, which must be 0 (the
+    # zero-drop contract, bit-exactness pinned in tests/test_fleet.py).
+    from eventstreamgpt_tpu.serving import ServingFleet
+
+    tunnel_probe("fleet", extras)
+
+    def fleet_replica():
+        e = GenerationEngine(
+            model,
+            state.params,
+            config,
+            template=eng_cohorts[0],
+            n_slots=BATCH,
+            max_len=SEQ_LEN,
+            decode_chunk=ENGINE_CHUNK,
+            dispatch_depth=2,
+            max_prompt_len=SEQ_LEN - GEN_NEW,
+            min_bucket=32,
+            mesh=mesh,
+            hot_swap=True,
+        )
+        # Trickle arrivals admit single requests: pin group size 1 and warm
+        # one request per reachable bucket (the service arm's discipline).
+        e.scheduler.group_sizes = (1,)
+        e.run(
+            [
+                Request(prompt=p, max_new_events=4, request_id=-1 - i)
+                for i, p in enumerate(bucket_reps.values())
+            ],
+            fetch_results=False,
+        )
+        e.reset()
+        return e
+
+    def fleet_service():
+        return ServingService(
+            [fleet_replica()],
+            lanes=(
+                LaneConfig("interactive", priority=0, max_pending=8 * BATCH),
+                LaneConfig("batch", priority=1, min_share=0.25, max_pending=8 * BATCH),
+            ),
+        )
+
+    fleet = ServingFleet(
+        {"svc0": fleet_service(), "svc1": fleet_service()},
+        base_key=jax.random.PRNGKey(11),
+    )
+    fleet_trace = [
+        (
+            f"subject-{i}",
+            Request(
+                prompt=eng_prompt_rows[i][0],
+                max_new_events=eng_prompt_rows[i][2],
+                request_id=i,
+                arrival_time=float(arrivals[i]),
+            ),
+            "batch" if i % 10 >= 7 else "interactive",
+        )
+        for i in range(N_LAT)
+    ]
+    fleet.promote(state.params, at_time=float(arrivals[N_LAT // 2]))
+    fleet_results = fleet.run(fleet_trace, use_arrival_times=True, fetch_results=False)
+    fleet_lats = sorted(1000.0 * r.latency for r in fleet_results)
+    fleet_p50 = fleet_lats[len(fleet_lats) // 2]
+    fleet_p95 = fleet_lats[min(int(len(fleet_lats) * 0.95), len(fleet_lats) - 1)]
+    fleet_swap = fleet.swap_report()
+    fleet_split = {
+        sid: sum(1 for r in fleet_results if r.service == sid)
+        for sid in fleet.services
+    }
+
     # ---- zero-shot end-to-end (VERDICT r05 #7): the composed generate →
     # label → aggregate path — the workload the generation engine exists
     # for. Resident prompts (the production zero-shot path), the shipped
@@ -1459,6 +1542,15 @@ def main():
                 "service_prefill_deferrals": svc_stats["replicas"][0][
                     "prefill_deferrals"
                 ],
+                # Serving fleet detail (r12): geometry, router subject
+                # split, and the swap ledger behind the headline fleet_*
+                # keys in the tail block.
+                "fleet_services": len(fleet.services),
+                "fleet_requests": len(fleet_results),
+                "fleet_p50_latency_ms": round(fleet_p50, 1),
+                "fleet_router_split": fleet_split,
+                "fleet_promotions": fleet_swap["promotions"],
+                "fleet_swap_held_peak": fleet_swap["held_peak"],
                 "width1024_n_params": wide_params,
                 "zeroshot_subjects": zs_subjects,
                 "zeroshot_num_samples": ZS_SAMPLES,
@@ -1478,6 +1570,12 @@ def main():
                 "generate_wasted_decode_frac": round(generate_wasted_frac, 4),
                 "engine_p50_latency_ms": round(engine_p50, 1),
                 "service_p50_latency_ms": round(service_p50, 1),
+                # Detail keys displaced from the tail by the r12 fleet
+                # headline triple (their adjacent headline companions stay
+                # in the tail).
+                "na_vs_ci_probe_step_ratio": round(na_probe_ms / padded_probe_ms, 2),
+                "engine_wasted_decode_frac": eng_stats["wasted_decode_frac"],
+                "zeroshot_frac_unpredictable": round(zs_frac_unpredictable, 4),
                 # Detail keys displaced from the tail by the r11 ETL A/B
                 # pair; both verdicts are recoverable from their adjacent
                 # A/B dicts (min arm), which stay in the tail.
@@ -1520,7 +1618,6 @@ def main():
                 # lever off the production default) + the NA/CI cost ratio
                 # (probe/probe minimums on the same resident batch).
                 "na_fused_ab_probe_ms": {k: round(v, 2) for k, v in na_ab_ms.items()},
-                "na_vs_ci_probe_step_ratio": round(na_probe_ms / padded_probe_ms, 2),
                 # r09 lever 1: the hand-tiled Pallas dep-graph kernel vs the
                 # r06 fused-XLA formulation, measured at the step level on
                 # the same resident batch — the winner names the production
@@ -1536,7 +1633,6 @@ def main():
                 # prompt_i) through the engine vs the PR4 padded-cohort
                 # generate() path.
                 "engine_events_per_sec_per_chip": round(engine_rate, 1),
-                "engine_wasted_decode_frac": eng_stats["wasted_decode_frac"],
                 "engine_vs_generate_ratio": round(
                     engine_rate / max(gen_arm_rate, 1e-9), 3
                 ),
@@ -1571,6 +1667,19 @@ def main():
                     service_p95 / max(engine_p95, 1e-9), 3
                 ),
                 "service_reject_frac": svc_stats["reject_frac"],
+                # Pod-scale serving fleet headline (r12): the SAME Poisson
+                # trace through a 2-service consistent-hash router with a
+                # fleet-wide hot checkpoint swap armed at the trace
+                # midpoint. The ratio compares fleet p95 against the single
+                # service arm on identical traffic (routing + swap overhead
+                # is what it measures); swap_dropped_requests is the
+                # zero-downtime scoreboard — 0, or the swap broke the
+                # contract (bit-exactness pinned in tests/test_fleet.py).
+                "fleet_p95_latency_ms": round(fleet_p95, 1),
+                "fleet_vs_service_p95_ratio": round(
+                    fleet_p95 / max(service_p95, 1e-9), 3
+                ),
+                "swap_dropped_requests": fleet_swap["swap_dropped_requests"],
                 # Streaming sharded ETL A/B (r11): the parallel host
                 # pipeline vs the single-process r05 baseline on the same
                 # 20k-subject corpus, byte-identical artifacts (tier-1
@@ -1585,7 +1694,6 @@ def main():
                 # generate → label → aggregate path on resident prompts.
                 "zeroshot_generated_events_per_sec_per_chip": round(zs_gen_rate, 1),
                 "zeroshot_auroc": round(float(zs_auroc), 4),
-                "zeroshot_frac_unpredictable": round(zs_frac_unpredictable, 4),
                 "na_events_per_sec_per_chip": round(na_events_per_sec, 1),
                 "packed_seq1024_events_per_sec_per_chip": round(packed_events_per_sec, 1),
                 "tuning_loss": round(eval_metrics.get("tuning_loss", float("nan")), 4),

@@ -122,6 +122,7 @@ def _import_provider_hooks() -> None:
     test, not a code review.
     """
     from ..serving import engine as _engine  # noqa: F401
+    from ..serving import fleet as _fleet  # noqa: F401
     from ..serving import service as _service  # noqa: F401
     from ..training import sharding as _sharding  # noqa: F401
 
@@ -328,6 +329,8 @@ def aot_surface() -> dict[str, set[str]]:
         | {f"engine_kvq:{k}" for k in pc.canonical_kvq_engine_programs(8)}
         | {f"engine_sampling:{k}" for k in pc.canonical_sampling_engine_program()},
         "service": {f"service:{k}" for k in pc.canonical_service_programs(8)},
+        "fleet": {f"engine_tp:{k}" for k in pc.canonical_tp_engine_programs(4, 2)}
+        | {f"engine_swap:{k}" for k in pc.canonical_swap_engine_programs()},
         "ladder": {
             "ladder:fsdp8@w2048",
             "ladder:fsdp8@w4096",

@@ -43,6 +43,8 @@ __all__ = [
     "canonical_kvq_engine_programs",
     "canonical_sampling_engine_program",
     "canonical_service_programs",
+    "canonical_tp_engine_programs",
+    "canonical_swap_engine_programs",
     "check_no_f64",
     "check_no_host_transfers",
     "check_collective_budget",
@@ -333,6 +335,80 @@ def canonical_sampling_engine_program() -> dict:
     return engine.aot_programs(bucket_len=8, group=2)
 
 
+def canonical_tp_engine_programs(n_data: int = 4, n_model: int = 2) -> dict:
+    """The serve-time tensor-parallel engine programs on a
+    ``data×model`` mesh (``serving/engine.py`` with a ``model`` axis): the
+    params shard with the training TP rules (`training/sharding.TP_RULES`)
+    and the decode/prefill programs carry the per-layer all-reduces GSPMD
+    inserts — the serving fleet's widths-past-one-chip leg. The committed
+    ``engine_tp_dp4_tp2`` / ``engine_tp_prefill_dp4_tp2`` budgets pin the
+    contract that TP serving pays exactly the per-layer reduce pattern and
+    nothing more: an accidental re-replication (or a slot-axis gather
+    smuggled in by the sampling tail) is a byte blowup here long before it
+    is a latency cliff on a pod."""
+    import jax
+
+    from ..serving import GenerationEngine
+    from ..training.sharding import make_mesh
+
+    ge = _graft_entry()
+    _require_devices(n_data * n_model)
+    mesh = make_mesh(n_data, n_model)
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=2 * n_data,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        mesh=mesh,
+    )
+    assert engine.tensor_parallel
+    # include_prefill_stream: the dedicated-prefill split halves are hot-path
+    # programs on a prefill-tier fleet (the compute forward runs per
+    # admission group, the donating admit scatter per handoff) — they get
+    # the same gates as the fused prefill instead of escaping the census.
+    return engine.aot_programs(bucket_len=8, group=2, include_prefill_stream=True)
+
+
+def canonical_swap_engine_programs() -> dict:
+    """The hot-swap engine's programs, unsharded (the zero-downtime weight
+    swap leg of the serving fleet): the ordinary decode/prefill/boundary
+    set plus ``swap_reshard`` — the shadow-load program that pins a
+    host-loaded checkpoint to the live weights' layout so the flip is a
+    pure pointer swap. The reshard is gated f64-free, host-transfer-free,
+    and against a zero-collective budget (``engine_swap_reshard_1dev``):
+    a collective or callback here would stall live decode for the whole
+    swap window."""
+    import jax
+
+    from ..serving import GenerationEngine
+
+    ge = _graft_entry()
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=4,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        hot_swap=True,
+    )
+    # The split prefill halves ride the swap engine's set too (unsharded:
+    # zero-collective by construction, f64/host-transfer gated like the
+    # rest — a callback smuggled into prefill_compute or admit would stall
+    # the handoff exactly like one in decode).
+    return engine.aot_programs(bucket_len=8, group=2, include_prefill_stream=True)
+
+
 def canonical_service_programs(n_data: int = 8) -> dict:
     """The online serving service's dispatch programs on the dp8 mesh
     (``serving/service.py``): a 2-replica service whose replicas shard
@@ -510,6 +586,14 @@ def run_program_checks(
     # double-buffered pipeline.
     for label, (fn, args) in canonical_service_programs(8).items():
         programs[f"service:{label}"] = (fn, args)
+    # The serving fleet's r12 programs: the tensor-parallel engine on the
+    # dp4×tp2 mesh (decode/prefill must carry exactly the per-layer TP
+    # all-reduces, budgeted below) and the hot-swap engine with its
+    # shadow-load reshard (collective- and callback-free by contract).
+    for label, (fn, args) in canonical_tp_engine_programs(4, 2).items():
+        programs[f"engine_tp:{label}"] = (fn, args)
+    for label, (fn, args) in canonical_swap_engine_programs().items():
+        programs[f"engine_swap:{label}"] = (fn, args)
 
     lowered = {}
     for label, (fn, args) in programs.items():
@@ -538,6 +622,11 @@ def run_program_checks(
         budget_keys["service:prefill_b8"] = "service_prefill_dp8"
         budget_keys["service:boundary_pack"] = "service_boundary_dp8"
         budget_keys["service:decode_r1"] = "service_r1_dp8"
+        budget_keys["engine_tp:decode"] = "engine_tp_dp4_tp2"
+        budget_keys["engine_tp:prefill_b8"] = "engine_tp_prefill_dp4_tp2"
+        budget_keys["engine_tp:prefill_compute_b8"] = "engine_tp_prefill_compute_dp4_tp2"
+        budget_keys["engine_tp:admit"] = "engine_tp_admit_dp4_tp2"
+        budget_keys["engine_swap:swap_reshard"] = "engine_swap_reshard_1dev"
         for label, budget_key in budget_keys.items():
             log(f"compiling {label} for the collective budget gate")
             compiled = lowered[label].compile()

@@ -73,17 +73,35 @@ def _etl_build_shard_worker(task):
     them to parquet (see `DatasetBase.build_event_and_measurement_dfs_sharded`).
 
     `_FORK_SELF` holds ``(cls, shards, subject_id_col, subject_id_dtype,
-    schemas_by_df, stream_dir)``; the task is the shard index. Returns a
-    manifest: ``(shard_idx, [(event_type, events_fp, meas_fp | None), ...])``
+    schemas_by_df, stream_dir, source_slices)``; the task is the shard
+    index. ``source_slices`` is the parse-once handoff: per-shard parquet
+    slice paths for every path-valued source, parsed ONCE in the parent
+    (with original row positions stamped) and streamed to ``stream_dir``
+    so workers never re-parse the raw CSV/parquet and never inherit a raw
+    frame through fork memory. Returns a manifest:
+    ``(shard_idx, [(event_type, events_fp, meas_fp | None), ...])``
     in serial block order.
     """
-    cls, shards, subject_id_col, subject_id_dtype, schemas_by_df, stream_dir = _FORK_SELF
+    (
+        cls,
+        shards,
+        subject_id_col,
+        subject_id_dtype,
+        schemas_by_df,
+        stream_dir,
+        source_slices,
+    ) = _FORK_SELF
     w = task
     shard_map = shards[w]
     manifest = []
     for b, (event_type, events, meas) in enumerate(
         cls._iter_source_blocks(
-            shard_map, subject_id_col, subject_id_dtype, schemas_by_df, keep_row_pos=True
+            shard_map,
+            subject_id_col,
+            subject_id_dtype,
+            schemas_by_df,
+            keep_row_pos=True,
+            source_overrides=None if source_slices is None else source_slices[w],
         )
     ):
         ev_fp = Path(stream_dir) / f"shard{w}_block{b}_events.parquet"
@@ -163,6 +181,14 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         return Path(save_dir) / cls.DYNAMIC_MEASUREMENTS_FN
 
     # ------------------------------------------------- abstract backend ops
+    @classmethod
+    @abc.abstractmethod
+    def _parse_source(cls, src):
+        """Reads a path-valued raw source (csv/parquet) into the backend's
+        frame format, row order preserved — the ONE place raw bytes become
+        a frame, shared by `_load_input_df` and the sharded build's
+        parse-once handoff."""
+
     @classmethod
     @abc.abstractmethod
     def _load_input_df(cls, df, columns, subject_id_col=None, subject_ids_map=None,
@@ -306,6 +332,7 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         subject_id_dtype: Any,
         schemas_by_df: dict[Any, list[InputDFSchema]],
         keep_row_pos: bool = False,
+        source_overrides: dict[Any, Any] | None = None,
     ):
         """Yields ``(event_type, events_df, measurements_df | None)`` per
         (source df, schema[, range-leg]) block, in the serial enumeration
@@ -316,17 +343,32 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         ``keep_row_pos=True`` threads a ``__row_pos__`` column (the row's
         position in its loaded source df) through to the outputs so a
         sharded run can restore the exact serial row order on merge.
+
+        ``source_overrides`` maps a ``schemas_by_df`` key to a pre-sliced
+        replacement — the sharded build's parse-once handoff: either a
+        frame or a path to one of `_preparse_shard_sources`'s streamed
+        parquet slices (read back with `_read_df`, never `_parse_source` —
+        raw sources parse exactly once, in the parent). Slices carry a
+        ``__row_pos__`` column stamped from the ORIGINAL source, which
+        `_load_input_df` honors over slice-local row order, so the outputs
+        are bit-identical to loading the full source and filtering.
         """
-        for df, schemas in schemas_by_df.items():
+        for src, schemas in schemas_by_df.items():
             all_columns = list(itertools.chain.from_iterable(s.columns_to_load for s in schemas))
 
+            df = src if source_overrides is None else source_overrides.get(src, src)
+            if df is not src and isinstance(df, (str, Path)):
+                # A streamed parse-once slice: our own parquet, read with
+                # the backend reader so the one-parse-per-raw-source
+                # contract stays countable at `_parse_source`.
+                df = cls._read_df(Path(df))
             try:
                 df = cls._load_input_df(
                     df, all_columns, subject_id_col, subject_ids_map, subject_id_dtype,
                     keep_row_pos=keep_row_pos,
                 )
             except Exception as e:
-                raise ValueError(f"Errored while loading {df}") from e
+                raise ValueError(f"Errored while loading {src}") from e
 
             for schema in schemas:
                 sub_df = df
@@ -390,6 +432,54 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         )
 
     @classmethod
+    def _preparse_shard_sources(
+        cls,
+        schemas_by_df: dict[Any, list[InputDFSchema]],
+        shards: list[dict],
+        subject_id_col: str,
+        stream_dir: Path | str,
+    ) -> list[dict] | None:
+        """Parses each path-valued raw source ONCE and streams its per-shard
+        slices to parquet under ``stream_dir`` — the sharded build's
+        parse-once handoff.
+
+        Returns one ``{schemas_by_df key: slice path}`` map per shard
+        (``None`` when no source is a path). Every slice carries a
+        ``__row_pos__`` column stamped with the row's position in the
+        ORIGINAL parsed source, which `_load_input_df` honors over
+        slice-local order — that is what keeps the sharded merge's
+        ``__row_pos__`` sort (and therefore the whole cache) bit-identical
+        to the serial path. Sources parse one at a time and each frame is
+        dropped before the next parse; workers read back only their own
+        slices — parent peak RSS is O(one parsed source) no matter how
+        many sources the schema maps (the r11 bounded-RSS property,
+        preserved), and the slices land in the same ``stream_dir`` the
+        block outputs already use, so the merge's cleanup owns them too.
+        """
+        path_sources = [
+            src for src in schemas_by_df if isinstance(src, (str, Path))
+        ]
+        if not path_sources:
+            return None
+        stream_dir = Path(stream_dir)
+        stream_dir.mkdir(parents=True, exist_ok=True)
+        shard_keysets = [set(map(str, shard.keys())) for shard in shards]
+        out: list[dict] = [{} for _ in shards]
+        for si, src in enumerate(path_sources):
+            raw = cls._parse_source(src)
+            raw = raw.reset_index(drop=True)
+            raw = raw.assign(
+                __row_pos__=np.arange(len(raw), dtype=np.int64)
+            )
+            key = raw[subject_id_col].astype(str)
+            for w, keyset in enumerate(shard_keysets):
+                fp = stream_dir / f"preparse_src{si}_shard{w}.parquet"
+                cls._write_df(raw[key.isin(keyset)], fp, do_overwrite=True)
+                out[w][src] = fp
+            del raw, key
+        return out
+
+    @classmethod
     def build_event_and_measurement_dfs_sharded(
         cls,
         subject_ids_map: dict[Any, int],
@@ -425,7 +515,26 @@ class DatasetBase(abc.ABC, Generic[DF_T, INPUT_DF_T], SeedableMixin, TimeableMix
         stream_dir = Path(stream_dir)
         stream_dir.mkdir(parents=True, exist_ok=True)
         try:
-            payload = (cls, shards, subject_id_col, subject_id_dtype, schemas_by_df, stream_dir)
+            # Parse-once handoff: each path-valued source is parsed ONCE
+            # here and its per-shard slices streamed to parquet (original
+            # row positions stamped), so workers read pre-sliced parquet
+            # instead of re-parsing the raw CSV K times — the load/parse
+            # phase cost drops from K× to 1× serial (the r11 known cost,
+            # docs/ingestion.md) while parent peak RSS stays O(one parsed
+            # source): each frame is dropped before the next source
+            # parses, and nothing raw is held across the fork.
+            source_slices = cls._preparse_shard_sources(
+                schemas_by_df, shards, subject_id_col, stream_dir
+            )
+            payload = (
+                cls,
+                shards,
+                subject_id_col,
+                subject_id_dtype,
+                schemas_by_df,
+                stream_dir,
+                source_slices,
+            )
             manifests = _fork_map(
                 payload, _etl_build_shard_worker, list(range(len(shards))), n_workers
             )

@@ -101,6 +101,17 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
 
     # ------------------------------------------------------------ IO backend
     @classmethod
+    def _parse_source(cls, src) -> DF_T:
+        """Raw source file → frame, row order preserved (the one parse site:
+        `_load_input_df` and the sharded build's parse-once handoff share it)."""
+        fp = Path(src)
+        if fp.suffix == ".csv":
+            return pd.read_csv(fp)
+        if fp.suffix == ".parquet":
+            return pd.read_parquet(fp)
+        raise ValueError(f"Can't read dataframe from file of suffix {fp.suffix}")
+
+    @classmethod
     def _read_df(cls, fp: Path, **kwargs) -> DF_T:
         return pd.read_parquet(fp)
 
@@ -137,13 +148,7 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
                 raise ValueError("Must set subject_id_dtype if subject_id_col is set")
 
         if isinstance(df, (str, Path)):
-            fp = Path(df)
-            if fp.suffix == ".csv":
-                df = pd.read_csv(fp)
-            elif fp.suffix == ".parquet":
-                df = pd.read_parquet(fp)
-            else:
-                raise ValueError(f"Can't read dataframe from file of suffix {fp.suffix}")
+            df = cls._parse_source(df)
         elif isinstance(df, pd.DataFrame):
             df = df.copy()
         elif isinstance(df, Query):
@@ -154,7 +159,19 @@ class Dataset(DatasetBase[pd.DataFrame, Any]):
         else:
             raise TypeError(f"Input dataframe `df` is of invalid type {type(df)}!")
 
-        if keep_row_pos:
+        if "__row_pos__" in df.columns:
+            # A pre-sliced parse-once handoff frame: the parent already
+            # stamped each row's position in the ORIGINAL source. Honor it
+            # (as the index, so the labels that survive filtering are those
+            # positions) instead of slice-local row order — otherwise the
+            # sharded merge's position sort would interleave shards wrongly.
+            if keep_row_pos:
+                df = df.set_index(
+                    df["__row_pos__"].to_numpy()
+                ).drop(columns="__row_pos__")
+            else:
+                df = df.drop(columns="__row_pos__")
+        elif keep_row_pos:
             # Positions are row order in the loaded source; normalizing the
             # index makes the labels that survive filtering be exactly those
             # positions, identically for every subject shard of the same

@@ -119,12 +119,39 @@ class SlotState:
     active_steps: Array  # () int32: sum over decode steps of active slots
 
 
+@struct.dataclass
+class PrefillHandoff:
+    """A prefill-stream admission in flight between replicas: the prefill
+    forward's outputs (computed on the dedicated prefill replica) plus the
+    request metadata the target decode replica's admit scatter needs.
+    Everything array-valued stays on device end to end — the handoff is the
+    disaggregated-serving device-to-device transfer, not a host copy."""
+
+    requests: list = struct.field(pytree_node=False)
+    group: int = struct.field(pytree_node=False)  # compiled group width
+    big: Any = None  # (g, max_len, ...) prefilled content rows
+    caches: Any = None  # per-row KV caches (float; target quantizes on admit)
+    plen: Any = None  # (g,) true prompt lengths
+    budgets: Any = None  # (g,) per-row max_new_events
+    keys: Any = None  # (g, 2) post-prefill PRNG chains
+    first_event_real: Any = None  # (g,) bool
+
+
 def _as_raw_key(key) -> jnp.ndarray:
     """Normalizes a PRNG key to raw (2,) uint32 data."""
     key = jnp.asarray(key)
     if jnp.issubdtype(key.dtype, jnp.integer):
         return key.astype(jnp.uint32)
     return jax.random.key_data(key)
+
+
+def derive_request_key(base_key, index: int) -> jnp.ndarray:
+    """THE per-request key derivation: ``fold_in(base, index)`` as raw key
+    data. Engine, service, and fleet all bind accepted request ``index``'s
+    key through this one function — the bit-identity parity contract
+    (engine ≡ service ≡ fleet on the same accepted set) holds *because*
+    the derivation is structurally shared, not comment-enforced."""
+    return _as_raw_key(jax.random.fold_in(base_key, index))
 
 
 def _vmap_split(keys: Array) -> tuple[Array, Array]:
@@ -168,7 +195,21 @@ class GenerationEngine:
             (`DeadRowCriteria`) — semantically loss-free, saves full-horizon
             decode on unpredictable rows.
         mesh: optional device mesh with a ``data`` axis; slots shard over it
-            (``n_slots`` divisible by its size), params replicate.
+            (``n_slots`` divisible by its size). Params replicate — unless
+            the mesh also carries a ``model`` axis of size > 1, in which
+            case they shard tensor-parallel via the training TP rules
+            (`training/sharding.make_param_shardings`) and the decode /
+            prefill programs compile with the per-layer TP all-reduces
+            GSPMD inserts — the serve-time model parallelism that lets
+            widths exceeding one chip (the bench ladder's 4096 rung)
+            serve at all (docs/serving.md "The serving fleet").
+        hot_swap: enables zero-downtime checkpoint promotion: the engine
+            reserves a second (shadow) weight buffer — `load_shadow` puts
+            a new checkpoint beside the live one through a compiled
+            reshard-to-layout program, `flip` swaps the live pointer at a
+            chunk boundary. `slots_report` accounts ``params_bytes × 2``
+            while enabled so capacity planning never overcommits HBM
+            during a swap window.
         sampling_impl: the decode sampling tail. ``None``/"auto"/"pallas"/
             "pallas_interpret"/"xla" route every categorical head through
             the fused filter+draw+merge op (`ops.fused_sampling
@@ -210,6 +251,7 @@ class GenerationEngine:
         device_criteria: Sequence[DeviceCriterion] = (),
         stop_dead_rows: bool = True,
         mesh: Optional[Mesh] = None,
+        hot_swap: bool = False,
         sampling_impl: str | None = None,
         top_k: int | None = None,
         top_p: float | None = None,
@@ -240,6 +282,18 @@ class GenerationEngine:
                     f"n_slots ({self.n_slots}) must divide over the mesh 'data' axis "
                     f"({int(mesh.shape['data'])})"
                 )
+            extra_axes = set(mesh.axis_names) - {"data", "model"}
+            if extra_axes:
+                raise ValueError(
+                    f"serving meshes carry 'data' (slots) and optionally 'model' "
+                    f"(tensor-parallel params) axes only — an '{sorted(extra_axes)[0]}' "
+                    "axis would gather weights into every decode chunk; build the "
+                    "serve mesh with make_mesh(n_data, n_model)"
+                )
+        # Serve-time tensor parallelism: a model axis of size > 1 shards the
+        # params with the training TP rules; GSPMD inserts the per-layer
+        # all-reduces into the decode/prefill compiles.
+        self.tensor_parallel = mesh is not None and int(mesh.shape.get("model", 1)) > 1
         if base_key is None:
             base_key = jax.random.PRNGKey(0)
         self._base_key = _as_raw_key(base_key)
@@ -306,17 +360,57 @@ class GenerationEngine:
 
         self._template = self._normalize_prompt(template)
         self._state = self._init_state()
+        self._param_shardings = None
         if mesh is not None:
             self._state = jax.device_put(self._state, self._state_shardings())
-            self.params = jax.device_put(params, NamedSharding(mesh, P()))
+            if self.tensor_parallel:
+                from ..training.sharding import make_param_shardings
 
+                # strict: a model axis whose rules shard (almost) nothing is
+                # an HBM budget lie at serve time — the engine exists to host
+                # widths past one chip, so a layout that replicates the big
+                # tables must fail HERE (per-replica, fast, with the leaf
+                # report) rather than OOM on the first admit. verbose=False
+                # only mutes the small-leaf warnings a fleet would print once
+                # per replica; strict errors still raise.
+                self._param_shardings = make_param_shardings(
+                    params, mesh, strict=True, verbose=False
+                )
+            else:
+                self._param_shardings = jax.tree_util.tree_map(
+                    lambda _: NamedSharding(mesh, P()), params
+                )
+            self.params = jax.device_put(params, self._param_shardings)
+
+        # Hot-swap double buffering: a second (shadow) weight buffer the
+        # fleet loads the next checkpoint into while this one serves; `flip`
+        # swaps the live pointer at a drained chunk boundary.
+        self.hot_swap = bool(hot_swap)
+        self._shadow_params = None
+        self._swap_reshard_memo = None
+        self.weights_version = 0
+
+        # Tensor-parallel layouts pin the output state to the input layout:
+        # without the pin GSPMD propagation reshards small replicated state
+        # leaves over `model`, silently dropping their donation (the Tier C
+        # donation audit's dp4_tp2 finding, reproduced verbatim on the TP
+        # engine) and forcing a reshard per dispatch.
+        self._state_out_shardings = (
+            self._state_shardings() if self.tensor_parallel else None
+        )
         # Compiled-program memos: decode is ONE program; prefill one per
         # (bucket, group), extract one per group width.
         self._decode_jit = jax.jit(
             self._decode_chunk_na if self._is_na else self._decode_chunk_ci,
             donate_argnums=(1,),
+            out_shardings=self._state_out_shardings,
         )
         self._prefill_jits: dict[tuple[int, int], Any] = {}
+        # Prefill-stream split programs: the bucketed prefill forward with no
+        # slot scatter (runs on a dedicated prefill replica) and the admit
+        # scatter alone (runs on the decode replica receiving the handoff).
+        self._prefill_compute_jits: dict[tuple[int, int], Any] = {}
+        self._admit_jits: dict[int, Any] = {}
         self._extract_jits: dict[int, Any] = {}
         # Packs done/cursor/base_len/n_generated into ONE (4, n_slots)
         # array so the boundary readback is a single async host copy.
@@ -611,10 +705,43 @@ class GenerationEngine:
             fn = functools.partial(
                 self._prefill_na if self._is_na else self._prefill_ci, bucket_len
             )
-            self._prefill_jits[key] = jax.jit(fn, donate_argnums=(1,))
+            self._prefill_jits[key] = jax.jit(
+                fn, donate_argnums=(1,), out_shardings=self._state_out_shardings
+            )
         return self._prefill_jits[key]
 
-    def _prefill_ci(self, Lb, params, state, pbig, plen, budgets, keys, slots):
+    def _prefill_compute_jit(self, bucket_len: int, group: int):
+        """The prefill forward WITHOUT the slot scatter — the program a
+        dedicated prefill replica dispatches (`prefill_compute`)."""
+        key = (bucket_len, group)
+        if key not in self._prefill_compute_jits:
+            fn = functools.partial(
+                self._prefill_forward_na if self._is_na else self._prefill_forward_ci,
+                bucket_len,
+            )
+            self._prefill_compute_jits[key] = jax.jit(fn)
+        return self._prefill_compute_jits[key]
+
+    def _admit_jit(self, group: int):
+        """The admit scatter alone — the (cheap) program a decode replica
+        runs to take a prefill-stream handoff at a chunk boundary."""
+        if group not in self._admit_jits:
+
+            def fn(state, big1, caches1, plen, budgets, keys1, first_event_real, slots):
+                return self._admit(
+                    state, big1, caches1, plen, budgets, keys1, slots, first_event_real
+                )
+
+            self._admit_jits[group] = jax.jit(
+                fn, donate_argnums=(0,), out_shardings=self._state_out_shardings
+            )
+        return self._admit_jits[group]
+
+    def _prefill_forward_ci(self, Lb, params, pbig, plen, keys):
+        """The bucketed prefill forward + first-event sample, WITHOUT the
+        slot scatter — the compute half the dedicated prefill stream runs on
+        its own replica. Returns ``(big1, caches1, keys1, first_event_real)``
+        exactly as `_admit` consumes them."""
         n = pbig.batch_size
         view = pbig.slice((slice(None), slice(0, Lb)))
         out = self.model.apply(
@@ -630,18 +757,23 @@ class GenerationEngine:
         sample = self._sample_rows(preds_last, em_last, step_keys)
         big1 = append_new_event(pbig, sample, self.config, plen)
         big1 = update_last_event_data(big1, sample, self.config, plen + 1)
+        return big1, out.past_key_values, new_keys, sample.event_mask
+
+    def _prefill_ci(self, Lb, params, state, pbig, plen, budgets, keys, slots):
+        big1, caches1, keys1, fer = self._prefill_forward_ci(
+            Lb, params, pbig, plen, keys
+        )
         return self._admit(
-            state,
-            big1,
-            out.past_key_values,
-            plen,
-            budgets,
-            new_keys,
-            slots,
-            first_event_real=sample.event_mask,
+            state, big1, caches1, plen, budgets, keys1, slots, first_event_real=fer
         )
 
     def _prefill_na(self, Lb, params, state, pbig, plen, budgets, keys, slots):
+        big, past, keys1, fer = self._prefill_forward_na(Lb, params, pbig, plen, keys)
+        return self._admit(
+            state, big, past, plen, budgets, keys1, slots, first_event_real=fer
+        )
+
+    def _prefill_forward_na(self, Lb, params, pbig, plen, keys):
         n = pbig.batch_size
         config = self.config
         n_levels = len(self._measurements_to_fill_list)
@@ -701,16 +833,7 @@ class GenerationEngine:
                     tuple(sorted(self._measurements_to_fill_list[level], key=str))
                 ),
             )
-        return self._admit(
-            state,
-            big,
-            past,
-            plen,
-            budgets,
-            new_keys,
-            slots,
-            first_event_real=first_event_real,
-        )
+        return big, past, new_keys, first_event_real
 
     def _admit(self, state, big1, caches1, plen, budgets, keys1, slots, first_event_real):
         """Scatters prefilled rows into the slot state. ``slots`` may carry
@@ -850,32 +973,101 @@ class GenerationEngine:
     def _request_key(self, req: Request) -> jnp.ndarray:
         if req.key is not None:
             return _as_raw_key(req.key)
-        return _as_raw_key(jax.random.fold_in(self._base_key, req.admission_index))
+        return derive_request_key(self._base_key, req.admission_index)
 
-    def _dispatch_group(self, group) -> None:
-        n, g = len(group.requests), group.group_size
-        rows = [self._pad_prompt_row(r.prompt) for r in group.requests]
+    def _group_arrays(self, requests: list, g: int):
+        """Stacks a same-bucket request group into the prefill program's
+        array arguments, padded to compiled group width ``g`` with inert
+        rows. Shared by the local prefill dispatch and the prefill-stream
+        compute half — identical inputs are half of the handoff's
+        bit-identity contract."""
+        n = len(requests)
+        rows = [self._pad_prompt_row(r.prompt) for r in requests]
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.concatenate(xs, axis=0), *rows)
         if g > n:
             # Inert pad rows: slot index == n_slots scatters with mode="drop".
             stacked = jax.tree_util.tree_map(
                 lambda x: jnp.pad(x, [(0, g - n)] + [(0, 0)] * (x.ndim - 1)), stacked
             )
-        plen = jnp.asarray(
-            [r.prompt_len for r in group.requests] + [1] * (g - n), jnp.int32
-        )
+        plen = jnp.asarray([r.prompt_len for r in requests] + [1] * (g - n), jnp.int32)
         budgets = jnp.asarray(
-            [r.max_new_events for r in group.requests] + [1] * (g - n), jnp.int32
+            [r.max_new_events for r in requests] + [1] * (g - n), jnp.int32
         )
         keys = jnp.stack(
-            [self._request_key(r) for r in group.requests]
+            [self._request_key(r) for r in requests]
             + [jnp.zeros((2,), jnp.uint32)] * (g - n)
         )
+        return stacked, plen, budgets, keys
+
+    def _dispatch_group(self, group) -> None:
+        n, g = len(group.requests), group.group_size
+        stacked, plen, budgets, keys = self._group_arrays(group.requests, g)
         slots = jnp.asarray(group.slots + [self.n_slots] * (g - n), jnp.int32)
         self._state = self._prefill_jit(group.bucket_len, g)(
             self.params, self._state, stacked, plen, budgets, keys, slots
         )
         for r, s in zip(group.requests, group.slots):
+            self._table[s] = r
+            self._slot_epoch[s] = self._dispatched_chunks
+
+    # ------------------------------------------------- prefill-stream handoff
+    def prefill_compute(self, requests: list, bucket_len: int, group: int):
+        """Runs the bucketed prefill forward on THIS engine without touching
+        its slot state — the dedicated-prefill-stream compute half
+        (`serving/fleet.PrefillStream`). Returns a `PrefillHandoff` whose
+        arrays are exactly what the target replica's `admit_prefilled`
+        scatter consumes; because the forward, the sampling tail, and the
+        per-request keys are identical to the local `_dispatch_group` path,
+        the admitted slot state — and every decode after it — is
+        bit-identical to local prefill.
+
+        Every request must carry an explicit PRNG key: the stream crosses
+        engines, and a key derived from THIS engine's base key would break
+        the target's determinism contract (the service/fleet assign keys at
+        accept time, so theirs always do)."""
+        for r in requests:
+            if r.key is None:
+                raise ValueError(
+                    "prefill_compute requires explicit request keys (the "
+                    "service/fleet assign them at accept time); a key derived "
+                    "from the prefill replica's base key would not survive the "
+                    "cross-engine handoff"
+                )
+        stacked, plen, budgets, keys = self._group_arrays(requests, group)
+        big1, caches1, keys1, fer = self._prefill_compute_jit(bucket_len, group)(
+            self.params, stacked, plen, keys
+        )
+        return PrefillHandoff(
+            requests=list(requests),
+            group=group,
+            big=big1,
+            caches=caches1,
+            plen=plen,
+            budgets=budgets,
+            keys=keys1,
+            first_event_real=fer,
+        )
+
+    def admit_prefilled(self, handoff: "PrefillHandoff", slots: list[int]) -> None:
+        """Scatters a prefill-stream handoff into this engine's slots — the
+        only work the decode replica pays for an admission when a dedicated
+        prefill tier runs (the full prefill forward happened on the prefill
+        replica's dispatch stream)."""
+        n, g = len(handoff.requests), handoff.group
+        if len(slots) != n:
+            raise ValueError(f"{n} handoff rows need {n} slots, got {len(slots)}")
+        slots_arr = jnp.asarray(list(slots) + [self.n_slots] * (g - n), jnp.int32)
+        self._state = self._admit_jit(g)(
+            self._state,
+            handoff.big,
+            handoff.caches,
+            handoff.plen,
+            handoff.budgets,
+            handoff.keys,
+            handoff.first_event_real,
+            slots_arr,
+        )
+        for r, s in zip(handoff.requests, slots):
             self._table[s] = r
             self._slot_epoch[s] = self._dispatched_chunks
 
@@ -1067,6 +1259,71 @@ class GenerationEngine:
                 time.sleep(1e-3)  # waiting on arrivals
         return sorted(results, key=lambda r: r.admission_index)
 
+    # ---------------------------------------------------- hot weight swap
+    def _swap_reshard_jit(self):
+        """The shadow-load program: an identity jit pinned to the live
+        params' layout, so a host-loaded checkpoint lands in the shadow
+        buffer already resharded/laid out exactly like the weights the
+        decode program reads — the flip is then a pure pointer swap, no
+        compile, no reshard, no dispatch. Gated by graftcheck like any
+        canonical program (``engine_swap:swap_reshard``)."""
+        if self._swap_reshard_memo is None:
+            if self._param_shardings is not None:
+                self._swap_reshard_memo = jax.jit(
+                    lambda p: p, out_shardings=self._param_shardings
+                )
+            else:
+                self._swap_reshard_memo = jax.jit(lambda p: p)
+        return self._swap_reshard_memo
+
+    def load_shadow(self, new_params) -> None:
+        """Loads ``new_params`` into the shadow weight buffer beside the
+        live weights (`hot_swap` must be enabled — `slots_report` has been
+        accounting the second buffer since construction, so this allocation
+        never overcommits HBM). Serving continues on the live buffer; call
+        `flip` at a drained chunk boundary to promote."""
+        if not self.hot_swap:
+            raise RuntimeError(
+                "hot_swap is disabled for this engine; construct with "
+                "hot_swap=True to reserve the shadow weight buffer"
+            )
+        live = jax.tree_util.tree_structure(self.params)
+        new = jax.tree_util.tree_structure(new_params)
+        if live != new:
+            raise ValueError(
+                "shadow checkpoint's parameter tree does not match the live "
+                f"weights: {new} vs {live}"
+            )
+        self._shadow_params = self._swap_reshard_jit()(new_params)
+
+    @property
+    def shadow_loaded(self) -> bool:
+        return self._shadow_params is not None
+
+    def flip(self) -> None:
+        """Swaps the live and shadow weight pointers — the zero-downtime
+        promotion step. Requires a loaded shadow and a drained engine (no
+        resident slots, no in-flight boundaries): a flip under residents
+        would decode half a request on each checkpoint, breaking the
+        post-flip bit-identity contract (pending queued requests are fine —
+        they prefill after the flip, wholly on the new weights). The old
+        weights stay in the shadow buffer for rollback until the next
+        `load_shadow` or `drop_shadow`."""
+        if self._shadow_params is None:
+            raise RuntimeError("no shadow checkpoint loaded (call load_shadow first)")
+        if self.occupied or self._inflight:
+            raise RuntimeError(
+                f"flip requires a drained engine: {self.occupied} resident "
+                f"slots, {len(self._inflight)} in-flight boundaries — drain "
+                "(stop admitting, resolve every boundary) before flipping"
+            )
+        self.params, self._shadow_params = self._shadow_params, self.params
+        self.weights_version += 1
+
+    def drop_shadow(self) -> None:
+        """Releases the shadow buffer's arrays (the rollback checkpoint)."""
+        self._shadow_params = None
+
     def reset(self) -> None:
         """Clears all slot/queue state, keeping every compiled program.
 
@@ -1143,6 +1400,11 @@ class GenerationEngine:
             params_bytes = sum(
                 x.nbytes for x in jax.tree_util.tree_leaves(self.params)
             )
+        if self.hot_swap:
+            # Double-buffered weights: the shadow buffer is reserved for the
+            # whole hot-swap lifetime (not just while a checkpoint is staged),
+            # so capacity planning never overcommits HBM during a swap window.
+            params_bytes = 2 * params_bytes
         budget = max(int(hbm_gb * 1e9) - params_bytes, 0)
 
         per_dtype = {}
@@ -1168,6 +1430,7 @@ class GenerationEngine:
         return {
             "kv_cache_dtype": active_name,
             "hbm_budget_gb": hbm_gb,
+            "hot_swap": self.hot_swap,
             "params_bytes": params_bytes,
             "row_bytes_per_slot": int(row_bytes),
             "per_dtype": per_dtype,
@@ -1195,10 +1458,23 @@ class GenerationEngine:
         return report
 
     # -------------------------------------------------- AOT (graftcheck B)
-    def aot_programs(self, bucket_len: int | None = None, group: int = 1) -> dict:
+    def aot_programs(
+        self,
+        bucket_len: int | None = None,
+        group: int = 1,
+        include_prefill_stream: bool = False,
+    ) -> dict:
         """(fn, args) pairs for the engine's compiled programs — graftcheck
         Tier B AOT-lowers these on the virtual mesh and gates them
-        host-transfer-free / f64-free / within the collective budget."""
+        host-transfer-free / f64-free / within the collective budget.
+
+        ``include_prefill_stream`` adds the dedicated-prefill split halves
+        (``prefill_compute_b{L}``: the scatter-free forward a prefill
+        replica dispatches; ``admit``: the state-donating scatter a decode
+        replica runs on a handoff) — the fleet's canonical tp/hot-swap
+        builders enable it so those hot-path programs get the same f64 /
+        host-transfer / collective-budget / HBM / donation gates as the
+        fused prefill, instead of escaping the census."""
         bucket_len = bucket_len or max(self.scheduler.buckets)
         t = self._template
 
@@ -1214,7 +1490,7 @@ class GenerationEngine:
         budgets = jnp.ones((group,), jnp.int32)
         keys = jnp.zeros((group, 2), jnp.uint32)
         slots = jnp.arange(group, dtype=jnp.int32)
-        return {
+        programs = {
             "decode": (self._decode_jit, (self.params, self._state)),
             f"prefill_b{bucket_len}": (
                 self._prefill_jit(bucket_len, group),
@@ -1224,6 +1500,23 @@ class GenerationEngine:
             # host: it must stay a pure pack (no host callbacks, no f64).
             "boundary_pack": (self._pack_boundary_jit, (self._state,)),
         }
+        if self.hot_swap:
+            # The shadow-load reshard (hot swap leg): must stay a pure
+            # layout pin — no collectives beyond the reshard itself, no
+            # host traffic — or the swap window would stall live decode.
+            programs["swap_reshard"] = (self._swap_reshard_jit(), (self.params,))
+        if include_prefill_stream:
+            pc_jit = self._prefill_compute_jit(bucket_len, group)
+            pc_args = (self.params, pbig, plen, keys)
+            programs[f"prefill_compute_b{bucket_len}"] = (pc_jit, pc_args)
+            # The admit scatter consumes exactly the compute half's outputs;
+            # abstract shapes suffice for AOT lowering (nothing executes).
+            big1, caches1, keys1, fer = jax.eval_shape(pc_jit, *pc_args)
+            programs["admit"] = (
+                self._admit_jit(group),
+                (self._state, big1, caches1, plen, budgets, keys1, fer, slots),
+            )
+        return programs
 
 
 # ------------------------------------------------- graftcheck Tier C census

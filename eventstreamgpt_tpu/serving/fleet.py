@@ -1,0 +1,653 @@
+"""Pod-scale serving fleet: a router tier over multiple `ServingService`s.
+
+One shared request stream, many services (each PR 6's multi-replica SLO
+scheduler over its own engines), four legs (ROADMAP item 2, the
+fleet-of-millions shape of the Gemma-on-TPU / pjit-TPUv4 serving papers in
+PAPERS.md):
+
+* **Session-affinity routing** (`serving/router.py`): subject key →
+  service through a consistent-hash ring, so a subject's
+  incremental-history requests land where their KV/slot state lives.
+  Placement is stable across restarts, invariant to enumeration order, and
+  moves only ~1/N of subjects on scale-out.
+* **Dedicated prefill stream** (`PrefillStream`, the PR 6 named
+  follow-up): a prefill-only replica runs the bucketed prefill forwards on
+  its own dispatch stream, concurrently with decode, and hands the
+  admitted slot state to the target decode replica at its next chunk
+  boundary (`GenerationEngine.prefill_compute` / `admit_prefilled`) — the
+  decode replicas pay only the admit scatter, not the prefill forward.
+* **Serve-time model parallelism**: services may be built over engines
+  whose mesh carries a ``model`` axis — params shard with the training TP
+  rules and the decode/prefill programs carry the per-layer all-reduces
+  (`GenerationEngine` ``mesh``) — widths past one chip serve behind the
+  same router.
+* **Zero-downtime hot weight swap** (`ServingFleet.promote`): every
+  engine double-buffers its weights (`hot_swap=True`); a promotion loads
+  the new checkpoint into every shadow buffer fleet-wide, then flips
+  services **one at a time**: new routes to the flipping service are held
+  at the fleet (never dropped, never rejected beyond the ordinary lane
+  bounds), residents drain and complete on the old weights, the drained
+  engines flip at a chunk boundary, and the held requests release. The
+  rest of the fleet serves throughout.
+
+Determinism contract (the PR 5/6 contract, one level up): the fleet binds
+every accepted request's PRNG key at accept time —
+``fold_in(fleet_key, fleet_admission_index)``, in accept order, before
+routing. *Where* a request runs (which service, which replica, which slot,
+through which prefill path, before or relative to which swap) never
+changes *what* it produces: fleet results are bit-identical to a single
+synchronous service serving the same accepted set in the same order, and
+every post-flip result is bit-identical to a fresh service on the new
+checkpoint (``tests/test_fleet.py`` pins all of it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Mapping, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..data.types import EventStreamBatch
+from .engine import GenerationEngine, _as_raw_key, derive_request_key
+from .router import ConsistentHashRouter
+from .scheduler import Request
+from .service import ServiceResult, ServingService
+
+
+def _params_mismatch(a: Any, b: Any) -> Optional[str]:
+    """First observable difference between two param trees, or ``None``.
+
+    Structure and per-leaf shape/dtype compare exactly; values compare by
+    object identity when possible (engines built from one params object)
+    and otherwise by a per-leaf fp32 |sum| fingerprint with a loose rtol —
+    differently-sharded copies of the same checkpoint reduce in different
+    orders (last-ulp), while two different checkpoints differ wildly.
+    A fingerprint can collide in principle; it exists to catch the easy
+    real mistake (two engines constructed from two checkpoints), not to
+    prove equality.
+    """
+    la = jax.tree_util.tree_flatten_with_path(a)
+    lb = jax.tree_util.tree_flatten_with_path(b)
+    if la[1] != lb[1]:
+        return "parameter tree structures differ"
+    for (pa, xa), (_, xb) in zip(la[0], lb[0]):
+        name = jax.tree_util.keystr(pa)
+        if tuple(xa.shape) != tuple(xb.shape) or xa.dtype != xb.dtype:
+            return (
+                f"{name}: {tuple(xa.shape)}/{xa.dtype} vs "
+                f"{tuple(xb.shape)}/{xb.dtype}"
+            )
+    if all(xa is xb for (_, xa), (_, xb) in zip(la[0], lb[0])):
+        return None
+    for (pa, xa), (_, xb) in zip(la[0], lb[0]):
+        if xa is xb:
+            continue
+        fa = float(jnp.sum(jnp.abs(jnp.asarray(xa).astype(jnp.float32))))
+        fb = float(jnp.sum(jnp.abs(jnp.asarray(xb).astype(jnp.float32))))
+        if abs(fa - fb) > 1e-4 * max(1.0, abs(fa), abs(fb)):
+            return (
+                f"{jax.tree_util.keystr(pa)}: weight fingerprints differ "
+                f"({fa:.6g} vs {fb:.6g})"
+            )
+    return None
+
+
+# --------------------------------------------------------- prefill stream
+class PrefillStream:
+    """The dedicated prefill tier: one prefill-only replica feeding a
+    service's decode replicas.
+
+    Admissions enqueue with a pre-reserved (replica, slot) target
+    (`ServingService._place`); `pump` groups them by (target, bucket),
+    dispatches the prefill forward on THIS engine's stream —
+    `GenerationEngine.prefill_compute`, the scatter-free half of the
+    bucketed prefill program — and hands each group's admitted slot state
+    to its target via the admit scatter. Decode replicas never execute a
+    prefill forward; prompt bursts ride the prefill replica's queue
+    instead of interleaving with decode under a per-boundary budget.
+
+    The prefill engine must share ``max_len`` and the prefill bucket ladder
+    with every target (validated at `attach`) and must serve the same
+    params — the handoff is bit-identical to local prefill only because
+    program, weights, and per-request keys all match. `attach` enforces
+    the weights leg too (structure/shape/dtype exactly; values by object
+    identity or a fp32 fingerprint — `_params_mismatch`), so prefilling
+    under checkpoint A and decoding under checkpoint B is a loud
+    construction-time error, not a silent contract break;
+    ``check_weights=False`` opts out for layouts the fingerprint cannot
+    compare (the caller then owns the contract).
+    """
+
+    def __init__(self, engine: GenerationEngine, check_weights: bool = True):
+        self.engine = engine
+        self.check_weights = bool(check_weights)
+        self._targets: Optional[list[GenerationEngine]] = None
+        self._queue: deque[tuple[Request, int, int]] = deque()
+        self._reserved: list[set] = []
+        # Accounting (the scheduler's padding counters live-as-stream).
+        self._prompt_events = 0
+        self._padded_events = 0
+        self.prefilled_total = 0
+        self.dispatches = 0
+
+    def attach(self, replicas: Sequence[GenerationEngine]) -> None:
+        if self._targets is not None:
+            raise RuntimeError("prefill stream is already attached to a service")
+        for i, e in enumerate(replicas):
+            if e is self.engine:
+                raise ValueError(
+                    "the prefill replica must be dedicated — it cannot also be "
+                    f"decode replica {i}"
+                )
+            if e.max_len != self.engine.max_len:
+                raise ValueError(
+                    f"prefill replica max_len ({self.engine.max_len}) != decode "
+                    f"replica {i} max_len ({e.max_len}) — the handoff caches "
+                    "would not line up"
+                )
+            if e.scheduler.buckets != self.engine.scheduler.buckets:
+                raise ValueError(
+                    f"prefill replica buckets {self.engine.scheduler.buckets} != "
+                    f"decode replica {i} buckets {e.scheduler.buckets} — bucketing "
+                    "must agree for the handoff to reproduce local prefill"
+                )
+            # The prefill replica's tail samples each request's FIRST event
+            # (the handoff carries it), so its filter must match the decode
+            # replicas'. Impl families (multi_op / fused xla / fused pallas)
+            # are bit-exact to each other by the r09 contract and may
+            # differ; top_k/top_p change the distribution and may not.
+            if (e.top_k, e.top_p) != (self.engine.top_k, self.engine.top_p):
+                raise ValueError(
+                    f"prefill replica sampling filter (top_k="
+                    f"{self.engine.top_k}, top_p={self.engine.top_p}) != decode "
+                    f"replica {i} (top_k={e.top_k}, top_p={e.top_p}) — the "
+                    "handed-off first event would be sampled under the wrong "
+                    "filter"
+                )
+            if self.check_weights:
+                mismatch = _params_mismatch(self.engine.params, e.params)
+                if mismatch is not None:
+                    raise ValueError(
+                        f"prefill replica weights != decode replica {i} weights "
+                        f"({mismatch}) — the handoff is bit-identical to local "
+                        "prefill only when program, weights, and keys all match; "
+                        "build both engines from the same checkpoint (or pass "
+                        "check_weights=False to own the contract yourself)"
+                    )
+        self._targets = list(replicas)
+        self._reserved = [set() for _ in replicas]
+
+    # ------------------------------------------------------------- queueing
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    def reserved_slots(self, replica_index: int) -> set:
+        """Slots spoken for by queued-but-not-yet-admitted prefills."""
+        return self._reserved[replica_index]
+
+    def enqueue(self, request: Request, replica_index: int, slot: int) -> None:
+        if self._targets is None:
+            raise RuntimeError("prefill stream is not attached to a service")
+        if request.key is None:
+            raise ValueError(
+                "prefill-stream requests must carry explicit keys (the service "
+                "binds them at accept time)"
+            )
+        self._reserved[replica_index].add(slot)
+        self._queue.append((request, replica_index, slot))
+
+    # ---------------------------------------------------------------- pump
+    def pump(self) -> int:
+        """Drains the queue: per-(target, bucket) groups through the prefill
+        replica's forward, handed to each target's slots. Returns the number
+        of requests admitted this round."""
+        if not self._queue:
+            return 0
+        items = list(self._queue)
+        self._queue.clear()
+        by_target_bucket: dict[tuple[int, int], list[tuple[Request, int]]] = {}
+        for req, ri, slot in items:
+            b = self.engine.scheduler.bucket_for(req.prompt_len)
+            by_target_bucket.setdefault((ri, b), []).append((req, slot))
+        admitted = 0
+        for ri, bucket_len in sorted(by_target_bucket):
+            pairs = by_target_bucket[(ri, bucket_len)]
+            target = self._targets[ri]
+            while pairs:
+                take, pairs = target.scheduler.take_group(pairs)
+                gw = target.scheduler.group_size_for(len(take))
+                handoff = self.engine.prefill_compute(
+                    [r for r, _ in take], bucket_len, gw
+                )
+                target.admit_prefilled(handoff, [s for _, s in take])
+                for _, s in take:
+                    self._reserved[ri].discard(s)
+                for r, _ in take:
+                    self._prompt_events += r.prompt_len
+                    self._padded_events += bucket_len
+                admitted += len(take)
+                self.dispatches += 1
+        self.prefilled_total += admitted
+        return admitted
+
+    def stats(self) -> dict:
+        padded = max(self._padded_events, 1)
+        return {
+            "prefilled_total": self.prefilled_total,
+            "dispatches": self.dispatches,
+            "pending": len(self._queue),
+            "prompt_events": self._prompt_events,
+            "padded_events": self._padded_events,
+            "padding_waste_frac": round(1.0 - self._prompt_events / padded, 4),
+        }
+
+
+# ------------------------------------------------------------------ fleet
+@dataclasses.dataclass
+class FleetResult:
+    """A finished fleet request: the engine result plus fleet routing
+    metadata — which subject, which service, which weights version."""
+
+    request_id: Any  # the caller's id
+    subject: Any
+    service: str
+    lane: str
+    replica: int
+    fleet_index: int  # fleet-global accept index (the PRNG fold)
+    weights_version: int  # the serving engine's checkpoint generation
+    batch: Optional[EventStreamBatch]
+    prompt_len: int
+    n_events: int
+    n_generated: int
+    arrival_time: float
+    completion_time: float
+
+    @property
+    def latency(self) -> float:
+        return self.completion_time - self.arrival_time
+
+
+class ServingFleet:
+    """Routes one shared request stream over multiple `ServingService`s
+    with consistent-hash session affinity, and upgrades them in place.
+
+    Args:
+        services: ``{service_id: ServingService}`` (or a sequence, ids
+            assigned ``svc0..svcN-1``). All services must share ``max_len``
+            (the fleet parity contract is one reference service serving the
+            whole accepted set).
+        base_key: fleet PRNG key. Accepted request i (with no explicit key)
+            runs with ``fold_in(base_key, i)`` — identical to ONE
+            `ServingService` (or one synchronous engine) built with this
+            key serving the same accepted set in the same order, wherever
+            the router actually sends it.
+        n_vnodes: virtual nodes per service on the router ring.
+        default_lane: lane used when ``submit``/``run`` carry none.
+    """
+
+    def __init__(
+        self,
+        services: Union[Mapping[str, ServingService], Sequence[ServingService]],
+        *,
+        base_key: Optional[jax.Array] = None,
+        n_vnodes: int = 64,
+        default_lane: Optional[str] = None,
+    ):
+        if not isinstance(services, Mapping):
+            services = {f"svc{i}": s for i, s in enumerate(services)}
+        self.services: dict[str, ServingService] = dict(services)
+        if not self.services:
+            raise ValueError("at least one service is required")
+        if len({id(s) for s in self.services.values()}) != len(self.services):
+            raise ValueError("services must be distinct instances")
+        max_lens = {s.max_len for s in self.services.values()}
+        if len(max_lens) != 1:
+            raise ValueError(
+                f"services must share max_len (the fleet parity contract) — "
+                f"got {sorted(max_lens)}"
+            )
+        self.max_len = next(iter(max_lens))
+        self.router = ConsistentHashRouter(self.services.keys(), n_vnodes=n_vnodes)
+        if base_key is None:
+            base_key = jax.random.PRNGKey(0)
+        self._base_key = _as_raw_key(base_key)
+        self.default_lane = default_lane
+        self._next_index = 0
+        # fleet index -> routing metadata; the fleet rewrites request_id to
+        # its own index, so a ServiceResult maps straight back.
+        self._meta: dict[int, dict] = {}
+        self._rejected_total = 0
+        self._accepted_total = 0
+        self._completed_total = 0
+        # Hot-swap state machine (see `promote`).
+        self._promotion: Optional[dict] = None
+        self._holding: set[str] = set()
+        self._held: dict[str, deque] = {sid: deque() for sid in self.services}
+        self._held_peak = 0
+        self._swap_history: list[dict] = []
+
+    # ------------------------------------------------------------- routing
+    def route(self, subject_key: Any) -> str:
+        """The service that owns ``subject_key``'s session state."""
+        return self.router.route(subject_key)
+
+    def _request_key(self, index: int):
+        return derive_request_key(self._base_key, index)
+
+    # ------------------------------------------------------------ admission
+    def submit(self, subject_key: Any, request: Request, lane: Optional[str] = None) -> bool:
+        """Routes and offers one request. True ⇒ accepted: a fleet admission
+        index and PRNG key are bound, and the request WILL complete (held
+        through swap windows, never dropped). False ⇒ rejected by the target
+        service's lane backpressure — no index is bound, so the accepted
+        set's results are unchanged."""
+        sid = self.route(subject_key)
+        svc = self.services[sid]
+        lane = lane or self.default_lane or svc.default_lane
+        if request.max_new_events < 1:
+            raise ValueError("max_new_events must be >= 1")
+        if request.prompt_len + request.max_new_events > self.max_len:
+            raise ValueError(
+                f"prompt ({request.prompt_len}) + budget ({request.max_new_events}) "
+                f"exceeds max_len ({self.max_len})"
+            )
+        if lane not in svc.lanes.configs:
+            raise KeyError(f"unknown lane {lane!r} on service {sid!r}")
+        index = self._next_index
+        internal = dataclasses.replace(request, request_id=index)
+        if internal.key is None:
+            internal.key = self._request_key(index)
+        if sid in self._holding:
+            # Swap window: the service is draining for its flip. Accept
+            # against the lane bound (held backlog counts toward it, so the
+            # release can never overflow the lane), hold at the fleet, and
+            # release after the flip — zero accepted requests dropped.
+            cfg = svc.lanes.configs[lane]
+            held_lane = sum(1 for _, ln in self._held[sid] if ln == lane)
+            if (
+                cfg.max_pending is not None
+                and svc.lanes.depth(lane) + held_lane >= cfg.max_pending
+            ):
+                self._rejected_total += 1
+                return False
+            self._held[sid].append((internal, lane))
+            self._held_peak = max(
+                self._held_peak, sum(len(q) for q in self._held.values())
+            )
+            accepted = True
+        else:
+            accepted = svc.submit(internal, lane)
+        if not accepted:
+            self._rejected_total += 1
+            return False
+        self._next_index += 1
+        self._accepted_total += 1
+        self._meta[index] = {
+            "subject": subject_key,
+            "service": sid,
+            "request_id": request.request_id,
+            "arrival": request.arrival_time,
+        }
+        return True
+
+    def _wrap(self, sr: ServiceResult, sid: str) -> FleetResult:
+        meta = self._meta.pop(sr.request_id)
+        self._completed_total += 1
+        svc = self.services[sid]
+        return FleetResult(
+            request_id=meta["request_id"],
+            subject=meta["subject"],
+            service=sid,
+            lane=sr.lane,
+            replica=sr.replica,
+            fleet_index=sr.request_id,
+            weights_version=svc.replicas[sr.replica].weights_version,
+            batch=sr.batch,
+            prompt_len=sr.prompt_len,
+            n_events=sr.n_events,
+            n_generated=sr.n_generated,
+            arrival_time=meta["arrival"],
+            completion_time=sr.completion_time,
+        )
+
+    # ------------------------------------------------------------ hot swap
+    def promote(self, new_params, at_time: Optional[float] = None) -> None:
+        """Fleet-wide zero-downtime checkpoint promotion.
+
+        Loads ``new_params`` into every engine's shadow buffer (decode
+        replicas and prefill replicas alike — all must be ``hot_swap``
+        engines), then flips services one at a time: routes to the flipping
+        service hold at the fleet, residents complete on the old weights,
+        the drained engines flip at a chunk boundary, held requests
+        release. Post-flip admissions run wholly on the new checkpoint —
+        bit-identical to a fresh service built on it.
+
+        Called idle (between runs), the whole state machine executes
+        synchronously before returning. Called with ``at_time`` (or while a
+        replay is in flight), it arms and `run`'s loop drives it — the
+        swap-under-traffic e2e. Zero accepted requests are dropped either
+        way (`swap_report`).
+        """
+        if self._promotion is not None:
+            raise RuntimeError("a promotion is already in flight")
+        for sid, svc in self.services.items():
+            for eng in self._service_engines(svc):
+                if not eng.hot_swap:
+                    raise RuntimeError(
+                        f"service {sid!r} has an engine without hot_swap=True; "
+                        "the fleet cannot promote without shadow buffers"
+                    )
+        self._promotion = {
+            "params": new_params,
+            "at_time": at_time,
+            "loaded": False,
+            "draining": None,
+            "flipped": [],
+            "held_released": 0,
+        }
+        if at_time is None and not self._any_busy():
+            while self._promotion is not None:
+                self._advance_promotion()
+
+    @staticmethod
+    def _service_engines(svc: ServingService) -> list[GenerationEngine]:
+        engines = list(svc.replicas)
+        if svc.prefill_stream is not None:
+            engines.append(svc.prefill_stream.engine)
+        return engines
+
+    def _advance_promotion(self) -> None:
+        p = self._promotion
+        if p is None:
+            return
+        if not p["loaded"]:
+            # Phase 1: stage the checkpoint into every shadow buffer
+            # fleet-wide (the HBM was reserved at engine construction).
+            for svc in self.services.values():
+                for eng in self._service_engines(svc):
+                    eng.load_shadow(p["params"])
+            p["loaded"] = True
+        if p["draining"] is None:
+            remaining = [
+                sid for sid in sorted(self.services) if sid not in p["flipped"]
+            ]
+            if not remaining:
+                self._swap_history.append(
+                    {
+                        "services": list(p["flipped"]),
+                        "held_released": p["held_released"],
+                    }
+                )
+                self._promotion = None
+                return
+            p["draining"] = remaining[0]
+            self._holding.add(p["draining"])
+        sid = p["draining"]
+        svc = self.services[sid]
+        if svc.busy():
+            return  # residents still draining on the old weights
+        for eng in self._service_engines(svc):
+            eng.flip()
+        p["flipped"].append(sid)
+        self._holding.discard(sid)
+        held = self._held[sid]
+        while held:
+            req, lane = held.popleft()
+            accepted = svc.submit(req, lane)
+            if not accepted:
+                # Capacity was reserved against the lane bound at accept
+                # time, so this is unreachable unless that accounting
+                # drifts — and then it must be LOUD in every interpreter
+                # mode (an assert vanishes under -O and the request would
+                # silently vanish with it).
+                raise RuntimeError(
+                    f"held release overflowed lane {lane!r} on service — "
+                    "the zero-drop contract's reservation accounting drifted"
+                )
+            p["held_released"] += 1
+        p["draining"] = None
+
+    def swap_report(self) -> dict:
+        """The zero-drop scoreboard: accepted minus completed minus still
+        physically in flight must be zero — no promotion window loses a
+        request.
+
+        ``in_flight`` counts where requests actually LIVE — the fleet's
+        held queues plus each service's accepted-not-yet-returned set
+        (`ServingService.pending`) — NOT the fleet's own ``_meta`` ledger,
+        which moves in lockstep with the accepted/completed counters and
+        would make the difference identically zero: a request the fleet
+        accepted but no queue holds (e.g. a held entry lost before its
+        post-flip release) must READ as dropped, not hide as forever
+        in-flight."""
+        held_now = sum(len(q) for q in self._held.values())
+        in_flight = held_now + sum(
+            s.pending() for s in self.services.values()
+        )
+        return {
+            "promotions": len(self._swap_history),
+            "swap_history": list(self._swap_history),
+            "swap_dropped_requests": self._accepted_total
+            - self._completed_total
+            - in_flight,
+            "in_flight": in_flight,
+            "held_now": held_now,
+            "held_peak": self._held_peak,
+        }
+
+    # -------------------------------------------------------------- serving
+    def _any_busy(self) -> bool:
+        return (
+            any(s.busy() for s in self.services.values())
+            or any(self._held.values())
+        )
+
+    def run(
+        self,
+        items: Sequence[tuple] = (),
+        *,
+        use_arrival_times: bool = False,
+        fetch_results: bool = True,
+    ) -> list[FleetResult]:
+        """Serves ``items`` — each ``(subject, Request)`` or
+        ``(subject, Request, lane)`` — to completion across the fleet and
+        returns `FleetResult`s in fleet-admission order.
+
+        The loop interleaves every service's `ServingService.step` (and any
+        armed promotion's state machine) on one host thread: each round
+        routes newly arrived requests, advances the swap, then gives each
+        service one scheduling round. With ``use_arrival_times`` the items
+        are a replay trace against the fleet clock (the Poisson benchmark
+        mode; rejected requests just don't appear in the results).
+        """
+        trace = [it if len(it) == 3 else (*it, None) for it in items]
+        if not use_arrival_times:
+            for subject, req, lane in trace:
+                self.submit(subject, req, lane)
+            trace = []
+        results: list[FleetResult] = []
+        t0 = time.perf_counter()
+        ptr = 0
+
+        while ptr < len(trace) or self._any_busy() or self._promotion is not None:
+            now = time.perf_counter() - t0
+            while ptr < len(trace) and trace[ptr][1].arrival_time <= now:
+                self.submit(*trace[ptr])
+                ptr += 1
+            if self._promotion is not None and (
+                self._promotion["at_time"] is None
+                or now >= self._promotion["at_time"]
+            ):
+                self._advance_promotion()
+            progressed = False
+            for sid in sorted(self.services):
+                svc = self.services[sid]
+                for sr in svc.step(lambda: time.perf_counter() - t0, fetch_results):
+                    results.append(self._wrap(sr, sid))
+                progressed = progressed or svc._last_step_progressed
+            if not progressed:
+                time.sleep(1e-3)  # waiting on arrivals / drain
+        return sorted(results, key=lambda r: r.fleet_index)
+
+    # ------------------------------------------------------------ accounting
+    def stats(self) -> dict:
+        return {
+            "n_services": len(self.services),
+            "service_ids": list(self.router.service_ids),
+            "accepted_total": self._accepted_total,
+            "completed_total": self._completed_total,
+            "rejected_total": self._rejected_total,
+            "swap": self.swap_report(),
+            "services": {sid: s.stats() for sid, s in self.services.items()},
+        }
+
+
+# ------------------------------------------------- graftcheck Tier C census
+def _census_programs():
+    """The serving fleet's compiled programs for the Tier C census: the
+    serve-time tensor-parallel engine on the dp4×tp2 mesh (decode/prefill
+    carry the per-layer TP all-reduces — budgeted so TP serving never pays
+    more than that pattern) and the hot-swap engine's program set including
+    ``swap_reshard``, the shadow-load layout pin that makes the flip a pure
+    pointer swap (zero collectives, zero host traffic — a violation here
+    would stall live decode for the whole swap window)."""
+    from ..analysis import program_checks as pc
+    from ..analysis.program_census import CensusProgram
+
+    donate = {"decode": (1,), "prefill_b8": (1,), "admit": (0,)}
+    budget_keys = {
+        "engine_tp:decode": "engine_tp_dp4_tp2",
+        "engine_tp:prefill_b8": "engine_tp_prefill_dp4_tp2",
+        "engine_tp:prefill_compute_b8": "engine_tp_prefill_compute_dp4_tp2",
+        "engine_tp:admit": "engine_tp_admit_dp4_tp2",
+        "engine_swap:swap_reshard": "engine_swap_reshard_1dev",
+    }
+    out = {}
+    for prefix, programs in (
+        ("engine_tp", pc.canonical_tp_engine_programs(4, 2)),
+        ("engine_swap", pc.canonical_swap_engine_programs()),
+    ):
+        for key, (fn, args) in programs.items():
+            label = f"{prefix}:{key}"
+            out[label] = CensusProgram(
+                label,
+                fn,
+                args,
+                donate_argnums=donate.get(key, ()),
+                budget_key=budget_keys.get(label),
+            )
+    return out
+
+
+def _register_census() -> None:
+    from ..analysis.program_census import register_aot_provider
+
+    register_aot_provider("fleet", _census_programs)
+
+
+_register_census()

@@ -183,6 +183,17 @@ class Scheduler:
                 return g
         return max(self.group_sizes)
 
+    def take_group(self, items: list) -> tuple[list, list]:
+        """Splits ``(taken, rest)`` off a same-bucket backlog by THE group
+        rule: the largest compiled group that is actually full, else the
+        smallest that fits the remainder (padded rows are inert). The one
+        policy shared by local admission planning and the prefill-stream
+        pump — the handoff's dispatch granularity must never drift from
+        local prefill's."""
+        fit = [g for g in self.group_sizes if g <= len(items)]
+        g = max(fit) if fit else self.group_size_for(len(items))
+        return items[:g], items[g:]
+
     def plan_admissions(
         self,
         free_slots: list[int],
@@ -239,11 +250,7 @@ class Scheduler:
         for bucket_len in sorted(by_bucket):
             reqs = by_bucket[bucket_len]
             while reqs:
-                # Largest compiled group that is actually full, else the
-                # smallest that fits the remainder (padded rows are inert).
-                fit = [g for g in self.group_sizes if g <= len(reqs)]
-                g = max(fit) if fit else self.group_size_for(len(reqs))
-                take, reqs = reqs[:g], reqs[g:]
+                take, reqs = self.take_group(reqs)
                 groups.append(
                     AdmissionGroup(
                         bucket_len=bucket_len,

@@ -47,7 +47,7 @@ from typing import Any, Optional, Sequence, Union
 import jax
 
 from ..data.types import EventStreamBatch
-from .engine import GenerationEngine, _as_raw_key
+from .engine import GenerationEngine, _as_raw_key, derive_request_key
 from .scheduler import EngineResult, Request
 from .slo import DEFAULT_LANES, INTERACTIVE, LaneConfig, LaneQueues
 
@@ -110,6 +110,14 @@ class ServingService:
         prefill_budget_events: per-replica, per-boundary cap on
             bucket-padded prefill events (prefill/decode disaggregation).
             ``None`` = unlimited (prefill bursts may stall decode).
+        prefill_stream: a `serving.fleet.PrefillStream` — the dedicated
+            prefill tier. When set, admissions are prefilled on the
+            stream's own replica concurrently with decode and the admitted
+            slot state is handed to the target decode replica at its next
+            chunk boundary, instead of the budget-capped interleave above
+            (the two disaggregation modes are mutually exclusive). Results
+            are bit-identical either way (the handoff contract —
+            `GenerationEngine.prefill_compute`).
         default_lane: lane used when ``submit``/``run`` get no lane.
     """
 
@@ -120,6 +128,7 @@ class ServingService:
         lanes: Sequence[LaneConfig] = DEFAULT_LANES,
         base_key: Optional[jax.Array] = None,
         prefill_budget_events: Optional[int] = None,
+        prefill_stream: Optional[Any] = None,
         default_lane: str = INTERACTIVE,
     ):
         self.replicas = list(replicas)
@@ -146,7 +155,15 @@ class ServingService:
         if default_lane not in self.lanes.configs:
             raise ValueError(f"default_lane {default_lane!r} is not a configured lane")
         self.default_lane = default_lane
+        if prefill_stream is not None and prefill_budget_events is not None:
+            raise ValueError(
+                "a dedicated prefill stream replaces the budget-capped "
+                "interleave; drop prefill_budget_events"
+            )
         self.prefill_budget_events = prefill_budget_events
+        self.prefill_stream = prefill_stream
+        if prefill_stream is not None:
+            prefill_stream.attach(self.replicas)
         if base_key is None:
             base_key = jax.random.PRNGKey(0)
         self._base_key = _as_raw_key(base_key)
@@ -157,11 +174,11 @@ class ServingService:
         # Outstanding decode work per replica (resident + engine-queued
         # budgets) — the budget-aware placement key.
         self._outstanding = [0] * len(self.replicas)
+        self._last_step_progressed = False
 
     # ------------------------------------------------------------ admission
     def _request_key(self, index: int):
-        # Byte-identical to GenerationEngine._request_key's default.
-        return _as_raw_key(jax.random.fold_in(self._base_key, index))
+        return derive_request_key(self._base_key, index)
 
     def submit(self, request: Request, lane: Optional[str] = None) -> bool:
         """Offers a request to a lane. True ⇒ accepted (an admission index
@@ -207,10 +224,26 @@ class ServingService:
         Capacity per replica = free slots minus its engine-queued backlog
         (placed-but-deferred prefills hold future slots). Each pick goes to
         the replica with the least outstanding decode budget (ties: lowest
-        index) — deterministic, and irrelevant to result content."""
-        capacity = [
-            max(len(e.free_slots()) - e.scheduler.pending, 0) for e in self.replicas
-        ]
+        index) — deterministic, and irrelevant to result content.
+
+        With a dedicated prefill stream, a pick additionally reserves a
+        concrete free slot on its replica and enqueues on the stream (the
+        prefill forward runs on the stream's replica; the decode replica
+        only pays the admit scatter) instead of entering the replica's own
+        scheduler queue."""
+        stream = self.prefill_stream
+        if stream is None:
+            capacity = [
+                max(len(e.free_slots()) - e.scheduler.pending, 0)
+                for e in self.replicas
+            ]
+        else:
+            free = [
+                [s for s in e.free_slots() if s not in stream.reserved_slots(ri)]
+                for ri, e in enumerate(self.replicas)
+            ]
+            free_iters = [iter(f) for f in free]
+            capacity = [len(f) for f in free]
         picks = self.lanes.pick(sum(capacity))
         for lane, req in picks:
             ri = min(
@@ -220,7 +253,10 @@ class ServingService:
             self._meta[req.request_id]["replica"] = ri
             self._outstanding[ri] += req.max_new_events
             capacity[ri] -= 1
-            self.replicas[ri].submit(req)
+            if stream is None:
+                self.replicas[ri].submit(req)
+            else:
+                stream.enqueue(req, ri, next(free_iters[ri]))
 
     def _wrap(self, er: EngineResult, ri: int) -> ServiceResult:
         meta = self._meta.pop(er.request_id)
@@ -268,36 +304,67 @@ class ServingService:
         t0 = time.perf_counter()
         ptr = 0
 
-        def busy() -> bool:
-            return (
-                ptr < len(trace)
-                or self.lanes.pending > 0
-                or any(e.occupied or e.scheduler.pending or e.inflight_chunks for e in self.replicas)
-            )
-
-        while busy():
+        while ptr < len(trace) or self.busy():
             now = time.perf_counter() - t0
             while ptr < len(trace) and trace[ptr][0].arrival_time <= now:
                 self.submit(*trace[ptr])
                 ptr += 1
-            self._place()
-            progressed = False
-            for ri, eng in enumerate(self.replicas):
-                eng.plan_and_dispatch(max_padded_events=self.prefill_budget_events)
-                if eng.occupied:
-                    eng.issue_chunk()
-                    progressed = True
-                if eng.inflight_chunks and (
-                    eng.inflight_chunks >= eng.dispatch_depth or not eng.occupied
-                ):
-                    for er in eng.resolve_chunk(
-                        time.perf_counter() - t0, fetch_results
-                    ):
-                        results.append(self._wrap(er, ri))
-                    progressed = True
-            if not progressed:
+            results.extend(self.step(lambda: time.perf_counter() - t0, fetch_results))
+            if not self._last_step_progressed:
                 time.sleep(1e-3)  # waiting on arrivals
         return sorted(results, key=lambda r: r.admission_index)
+
+    def pending(self) -> int:
+        """Requests accepted by THIS service and not yet returned — queued
+        in a lane, reserved on the prefill stream, or resident in a
+        replica. The fleet's zero-drop scoreboard sums these (plus its own
+        held queues) as the physical in-flight count, so a request the
+        fleet accepted but no service holds shows up as dropped."""
+        return len(self._meta)
+
+    def busy(self) -> bool:
+        """Work anywhere in the service: lane backlogs, the prefill stream's
+        queue, or any replica's queue/residents/in-flight boundaries."""
+        if self.lanes.pending > 0:
+            return True
+        if self.prefill_stream is not None and self.prefill_stream.pending:
+            return True
+        return any(
+            e.occupied or e.scheduler.pending or e.inflight_chunks
+            for e in self.replicas
+        )
+
+    def step(self, clock, fetch_results: bool = True) -> list[ServiceResult]:
+        """One scheduling round: place lane picks, pump the prefill stream
+        (dedicated-tier mode), and issue/resolve each replica's pipelined
+        decode chunks. Returns the requests that finished this round.
+
+        ``clock`` is a zero-arg callable returning the service-relative time
+        used to stamp completions. Extracted from `run` so an external
+        driver — the fleet's interleaved loop (`serving/fleet.py`) — can
+        multiplex many services without ceding control to any one of them.
+        `_last_step_progressed` tells the driver whether anything moved
+        (False ⇒ the round was pure polling and a short sleep is in order).
+        """
+        self._place()
+        results: list[ServiceResult] = []
+        progressed = False
+        if self.prefill_stream is not None:
+            progressed = self.prefill_stream.pump() > 0
+        for ri, eng in enumerate(self.replicas):
+            if self.prefill_stream is None:
+                eng.plan_and_dispatch(max_padded_events=self.prefill_budget_events)
+            if eng.occupied:
+                eng.issue_chunk()
+                progressed = True
+            if eng.inflight_chunks and (
+                eng.inflight_chunks >= eng.dispatch_depth or not eng.occupied
+            ):
+                for er in eng.resolve_chunk(clock(), fetch_results):
+                    results.append(self._wrap(er, ri))
+                progressed = True
+        self._last_step_progressed = progressed
+        return results
 
     # ------------------------------------------------------------ accounting
     def stats(self) -> dict:
@@ -312,6 +379,8 @@ class ServingService:
                 "replicas": [e.stats() for e in self.replicas],
             }
         )
+        if self.prefill_stream is not None:
+            report["prefill_stream"] = self.prefill_stream.stats()
         return report
 
     # -------------------------------------------------- AOT (graftcheck B)

@@ -116,8 +116,16 @@ def make_param_shardings(
     mesh: Mesh,
     strict: bool = False,
     max_replicated_frac: float = 0.5,
+    verbose: bool = True,
 ) -> Any:
     """NamedSharding tree for ``params``: TP rules + FSDP + replicated fallback.
+
+    ``verbose=False`` suppresses the replication warnings (strict-mode
+    errors still raise) — the serve-time TP path (`serving/engine.py`)
+    builds a layout per engine replica with ``strict=True, verbose=False``:
+    a fleet would otherwise print the same small-leaf report once per
+    replica, but a layout that replicates most parameter bytes still
+    raises at engine construction instead of OOMing at admit.
 
     Tensor-parallel rules apply first (``model`` axis; dimensions that don't
     divide the axis evenly are left unsharded for that rule — GSPMD would
@@ -187,7 +195,7 @@ def make_param_shardings(
         return NamedSharding(mesh, P())
 
     out = jax.tree_util.tree_map_with_path(rule_for, params)
-    if has_model and tp_skipped:
+    if has_model and tp_skipped and verbose:
         # Partial failures matter most when the widest matrices (embedding /
         # classification head — the motivation for TP) are the ones skipped.
         print(
@@ -213,7 +221,8 @@ def make_param_shardings(
                 f"{max_replicated_frac}. Check that hidden/vocab dims divide the "
                 "requested shard counts."
             )
-        print(f"WARNING: {msg}")
+        if verbose:
+            print(f"WARNING: {msg}")
     if (has_model or has_fsdp) and n_sharded == 0:
         msg = (
             "a parameter-sharding mesh axis was requested but NO parameter is "
@@ -222,7 +231,8 @@ def make_param_shardings(
         )
         if strict:
             raise ValueError(f"strict sharding: {msg}")
-        print(f"WARNING: {msg}")
+        if verbose:
+            print(f"WARNING: {msg}")
     return out
 
 

@@ -180,6 +180,74 @@ class TestRowPosPlumbing:
         assert "__row_pos__" not in out.columns
 
 
+class TestParseOnceHandoff:
+    """r12 satellite: the sharded build parses each raw source ONCE in the
+    parent and streams per-shard parquet slices with original row positions
+    stamped — the fast units pin the position plumbing; the slow e2e
+    (`TestParallelBuildBitIdentity` + the parse-count test below) pins
+    bit-identity and the 1×-parse contract."""
+
+    @staticmethod
+    def _df():
+        return pd.DataFrame(
+            {
+                "MRN": ["a", "b", "a", "c", "b", "c"],
+                "ts": pd.to_datetime(["2020-01-01"] * 6),
+                "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+            }
+        )
+
+    def test_load_honors_stamped_positions(self):
+        # A pre-sliced handoff frame carries ORIGINAL source positions; the
+        # loader must keep them, not re-derive slice-local row order.
+        df = self._df().assign(__row_pos__=np.arange(6, dtype=np.int64))
+        sliced = df[df["MRN"].isin(["b"])]  # source positions 1 and 4
+        out = Dataset._load_input_df(
+            sliced,
+            [("ts", InputDataType.TIMESTAMP), ("v", InputDataType.FLOAT)],
+            subject_id_col="MRN",
+            subject_ids_map={"b": 1},
+            subject_id_dtype=np.int64,
+            keep_row_pos=True,
+        )
+        assert out["__row_pos__"].tolist() == [1, 4]
+
+    def test_marker_dropped_without_keep_row_pos(self):
+        df = self._df().assign(__row_pos__=np.arange(6, dtype=np.int64))
+        out = Dataset._load_input_df(
+            df,
+            [("v", InputDataType.FLOAT)],
+            subject_id_col="MRN",
+            subject_ids_map={"a": 0, "b": 1, "c": 2},
+            subject_id_dtype=np.int64,
+        )
+        assert "__row_pos__" not in out.columns
+
+    def test_preparse_slices_disjoint_and_stamped(self, tmp_path):
+        src = str(tmp_path / "events.csv")
+        self._df().to_csv(src, index=False)
+        shards = [{"a": 0, "b": 1}, {"c": 2}]
+        slices = Dataset._preparse_shard_sources(
+            {src: []}, shards, "MRN", tmp_path / "stream"
+        )
+        # The handoff is parquet slice PATHS under stream_dir (bounded
+        # parent RSS: nothing raw survives the preparse loop), not frames.
+        assert all(Path(m[src]).is_file() for m in slices)
+        s0 = pd.read_parquet(slices[0][src])
+        s1 = pd.read_parquet(slices[1][src])
+        assert s0["__row_pos__"].tolist() == [0, 1, 2, 4]
+        assert s1["__row_pos__"].tolist() == [3, 5]
+        # Row-disjoint: together the slices tile the kept rows exactly once.
+        assert sorted(s0["__row_pos__"].tolist() + s1["__row_pos__"].tolist()) == list(
+            range(6)
+        )
+
+    def test_no_path_sources_is_a_noop(self, tmp_path):
+        assert (
+            Dataset._preparse_shard_sources({}, [{"a": 0}], "MRN", tmp_path) is None
+        )
+
+
 # ------------------------------------------- fast: sufficient-stat algebra
 class TestSufficientStats:
     def test_merge_equals_direct_stats(self):
@@ -302,6 +370,40 @@ class TestParallelBuildBitIdentity:
         )
         pd.testing.assert_frame_equal(ev_a, ev_b)
         pd.testing.assert_frame_equal(me_a, me_b)
+
+    def test_each_source_parsed_exactly_once(self, tmp_path, monkeypatch):
+        """r12 parse-once pin: the whole 3-worker sharded build parses each
+        raw source file exactly once (in the parent — workers read streamed
+        parquet slices through `_read_df`, never `_parse_source`). The parse
+        log is a file so forked workers' calls (there must be none) would
+        land in it too."""
+        raw = write_synthetic_raw_csvs(tmp_path / "raw", n_subjects=12, seed=5)
+        schema = make_schema(raw)
+        subjects_df, id_map = Dataset.build_subjects_dfs(schema.static)
+        dtype = subjects_df["subject_id"].dtype
+
+        log = tmp_path / "parse_log.txt"
+        orig = Dataset._parse_source.__func__
+
+        def logged(cls, src):
+            with open(log, "a") as f:
+                f.write(f"{src}\n")
+            return orig(cls, src)
+
+        monkeypatch.setattr(Dataset, "_parse_source", classmethod(logged))
+        ev, me = Dataset.build_event_and_measurement_dfs_sharded(
+            id_map,
+            schema.static.subject_id_col,
+            dtype,
+            schema.dynamic_by_df,
+            n_workers=3,
+            stream_dir=tmp_path / "shards",
+        )
+        assert len(ev) > 0 and len(me) > 0
+        parses = log.read_text().splitlines()
+        assert sorted(parses) == sorted(map(str, schema.dynamic_by_df)), (
+            f"each source must parse exactly once; saw {parses}"
+        )
 
 
 # --------------------------------------------------- slow: append-subjects

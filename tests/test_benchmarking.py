@@ -110,6 +110,13 @@ class TestBenchTailCapture:
         "kvq_engine_events_per_sec_per_chip",
         "kvq_slots_per_chip_ratio",
         "service_p95_latency_ms",
+        # r12 serving-fleet verdicts: the 2-service router replay of the
+        # service Poisson trace with a mid-trace hot checkpoint swap
+        # (bit-exactness + zero-drop pinned in tier-1 / the fleet chunk);
+        # swap_dropped_requests must render 0.
+        "fleet_p95_latency_ms",
+        "fleet_vs_service_p95_ratio",
+        "swap_dropped_requests",
         # r11 streaming-ETL A/B verdicts: the parallel host pipeline vs the
         # single-process r05 baseline on identical work (bit-identical
         # artifacts pinned in tier-1).

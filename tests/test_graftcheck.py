@@ -1083,7 +1083,14 @@ class TestCensusCompleteness:
         from eventstreamgpt_tpu.analysis import program_census as census
 
         providers = census.registered_providers()
-        assert set(providers) == {"training", "generation", "engine", "service", "ladder"}
+        assert set(providers) == {
+            "training",
+            "generation",
+            "engine",
+            "service",
+            "fleet",
+            "ladder",
+        }
 
     def test_tier_b_budget_keys_exist_in_collectives(self):
         import json as _json
