@@ -864,6 +864,33 @@ def main():
     kvq_slots = kvq_engine.slots_report()
     kvq_slots_ratio = kvq_slots["slots_per_chip_ratio_vs_bf16"]
 
+    # ---- speculative decoding (r13; serving/spec.py): the truncated-depth
+    # draft — the target's own first half, zero extra training — proposes
+    # K events per slot per round and the target verifies all of them in
+    # ONE batched forward. Same offline request set as the engine arm; the
+    # headline pair is spec_vs_engine_ratio (>1 = speculation beat
+    # one-event-per-forward decode on this checkpoint/draft) and
+    # spec_acceptance_rate (the lever that decides it: the win is roughly
+    # committed-per-round ÷ (1 + draft cost), so low acceptance degrades
+    # toward baseline — never below it by more than the draft's overhead,
+    # and never wrong samples; distribution-pinned in tests/test_spec.py).
+    from eventstreamgpt_tpu.serving import SpecConfig, truncated_draft
+
+    tunnel_probe("spec_engine", extras)
+    SPEC_K = 4
+    draft_cfg, draft_params = truncated_draft(
+        config, state.params, max(1, config.num_hidden_layers // 2)
+    )
+    draft_model = type(model)(draft_cfg)
+    spec_conf = SpecConfig(
+        model=draft_model, params=draft_params, config=draft_cfg, k=SPEC_K
+    )
+    spec_engine = engine_variant(spec=spec_conf)
+    spec_wall_s, spec_useful = timed_engine_arm(spec_engine)
+    spec_rate = spec_useful / spec_wall_s / n_devices
+    spec_stats = spec_engine.stats()
+    spec_slots = spec_engine.slots_report()
+
     # Poisson-arrival latency replay at ~70% of measured offline capacity.
     # Trickle arrivals admit single requests, so pin group size 1 and warm
     # ONE representative request per distinct bucket the replay can touch —
@@ -902,6 +929,40 @@ def main():
     )
     engine_p50 = latencies_ms[len(latencies_ms) // 2]
     engine_p95 = latencies_ms[min(int(len(latencies_ms) * 0.95), len(latencies_ms) - 1)]
+
+    # Spec-mode Poisson replay on the SAME trace (same arrivals, same
+    # budgets, the baseline arm's 70%-capacity rate): per-request latency
+    # when each dispatch can commit up to K+1 events. Trickle discipline
+    # matches the engine arm — group size 1, one warm request per bucket.
+    spec_engine.scheduler.group_sizes = (1,)
+    spec_engine.reset()
+    spec_engine.run(
+        [
+            Request(prompt=p, max_new_events=4, request_id=-1 - i)
+            for i, p in enumerate(bucket_reps.values())
+        ],
+        fetch_results=False,
+    )
+    spec_engine.reset()
+    spec_lat_results = spec_engine.run(
+        [
+            Request(
+                prompt=eng_prompt_rows[i][0],
+                max_new_events=eng_prompt_rows[i][2],
+                request_id=i,
+                arrival_time=float(arrivals[i]),
+            )
+            for i in range(N_LAT)
+        ],
+        use_arrival_times=True,
+        fetch_results=False,
+    )
+    spec_lat_ms = sorted(
+        1000.0 * (r.completion_time - float(arrivals[r.request_id]))
+        for r in spec_lat_results
+    )
+    spec_p50 = spec_lat_ms[len(spec_lat_ms) // 2]
+    spec_p95 = spec_lat_ms[min(int(len(spec_lat_ms) * 0.95), len(spec_lat_ms) - 1)]
 
     # ---- online serving service (r08; serving/service.py): the SAME
     # Poisson trace through the async double-buffered service — one replica
@@ -1513,6 +1574,25 @@ def main():
                 # block): sampling-tail impl and the per-dtype KV-cache
                 # footprint behind the kvq_* capacity keys.
                 "engine_sampling_impl": eng_stats["sampling_impl"],
+                # Detail keys displaced from the tail by the r13 spec keys
+                # (their headline equivalents remain in the tail block).
+                "sampling_impl_winner": min(
+                    sampling_fused_ab_ms, key=sampling_fused_ab_ms.get
+                ),
+                "service_reject_frac": svc_stats["reject_frac"],
+                "zeroshot_generated_events_per_sec_per_chip": round(zs_gen_rate, 1),
+                # Speculative-decoding detail (r13): geometry, per-request
+                # accounting, capacity cost of the resident draft, and the
+                # replay p50 behind the headline spec_* keys in the tail.
+                "spec_k": SPEC_K,
+                "spec_draft_layers": draft_cfg.num_hidden_layers,
+                "spec_rounds": spec_stats["spec_rounds"],
+                "spec_proposed_events": spec_stats["spec_proposed_events"],
+                "spec_accepted_events": spec_stats["spec_accepted_events"],
+                "spec_committed_events": spec_stats["spec_committed_events"],
+                "spec_draft_params_bytes": spec_slots["draft_params_bytes"],
+                "spec_draft_kv_bytes_per_slot": spec_slots["draft_kv_bytes_per_slot"],
+                "spec_p50_latency_ms": round(spec_p50, 1),
                 "kvq_bytes_per_slot_int8": kvq_slots["per_dtype"]["int8"][
                     "kv_bytes_per_slot"
                 ],
@@ -1642,9 +1722,6 @@ def main():
                 # multi-op tail — identical requests, bit-identical outputs,
                 # the lower wall names the production default.
                 "sampling_fused_ab_ms": sampling_fused_ab_ms,
-                "sampling_impl_winner": min(
-                    sampling_fused_ab_ms, key=sampling_fused_ab_ms.get
-                ),
                 # r09 lever 3: int8 KV-cache decode. Throughput is the
                 # bandwidth half of the verdict; kvq_slots_per_chip_ratio
                 # (max admissible slots vs the bf16 cache at a 16 GB HBM
@@ -1655,6 +1732,18 @@ def main():
                     kvq_rate / max(engine_rate, 1e-9), 3
                 ),
                 "kvq_slots_per_chip_ratio": kvq_slots_ratio,
+                # Speculative decoding headline (r13): K-event draft +
+                # one-pass verify vs one-event-per-forward decode on the
+                # SAME offline requests (ratio > 1 = the draft pays for
+                # itself at this acceptance rate), the acceptance rate that
+                # decides it, and the Poisson-replay p95 on the engine arm's
+                # trace. Correctness is tier-1-pinned (greedy parity + the
+                # per-head distribution chi-square in tests/test_spec.py);
+                # these keys are the measured speed verdict.
+                "spec_engine_events_per_sec_per_chip": round(spec_rate, 1),
+                "spec_vs_engine_ratio": round(spec_rate / max(engine_rate, 1e-9), 3),
+                "spec_acceptance_rate": spec_stats["spec_acceptance_rate"],
+                "spec_p95_latency_ms": round(spec_p95, 1),
                 # Online serving service headline (r08): the SAME Poisson
                 # trace through the async double-buffered service (1
                 # replica, depth-2 dispatch, budget-capped prefill, SLO
@@ -1666,7 +1755,6 @@ def main():
                 "service_vs_engine_p95_ratio": round(
                     service_p95 / max(engine_p95, 1e-9), 3
                 ),
-                "service_reject_frac": svc_stats["reject_frac"],
                 # Pod-scale serving fleet headline (r12): the SAME Poisson
                 # trace through a 2-service consistent-hash router with a
                 # fleet-wide hot checkpoint swap armed at the trace
@@ -1692,7 +1780,6 @@ def main():
                 "etl_vs_serial_ratio": etl_headline["etl_vs_serial_ratio"],
                 # Zero-shot end-to-end (VERDICT r05 #7): the composed
                 # generate → label → aggregate path on resident prompts.
-                "zeroshot_generated_events_per_sec_per_chip": round(zs_gen_rate, 1),
                 "zeroshot_auroc": round(float(zs_auroc), 4),
                 "na_events_per_sec_per_chip": round(na_events_per_sec, 1),
                 "packed_seq1024_events_per_sec_per_chip": round(packed_events_per_sec, 1),

@@ -42,6 +42,8 @@ __all__ = [
     "canonical_engine_programs",
     "canonical_kvq_engine_programs",
     "canonical_sampling_engine_program",
+    "canonical_spec_engine_programs",
+    "canonical_spec_engine_na_programs",
     "canonical_service_programs",
     "canonical_tp_engine_programs",
     "canonical_swap_engine_programs",
@@ -409,6 +411,96 @@ def canonical_swap_engine_programs() -> dict:
     return engine.aot_programs(bucket_len=8, group=2, include_prefill_stream=True)
 
 
+def canonical_spec_engine_programs(n_data: int = 8) -> dict:
+    """The r13 speculative-decoding engine programs, slots sharded
+    data-parallel over the virtual mesh: the draft-chunk program (K
+    one-event draft forwards + proposal recording), the verify program (ONE
+    K+1-event target forward on the vector-length cache branch + the
+    accept/commit math), the fused target+draft prefill, and the widened
+    boundary pack. The verify program is the serving hot loop's new center
+    of mass: it must stay f64-free, host-transfer-free, and show **zero new
+    collective kinds vs the baseline decode** (``engine_dp8``) — the
+    fused-sampling mesh rule (auto → XLA tail on multi-device meshes, no
+    all-gather of the slot-sharded logits plane) must keep holding inside
+    the K-event verify forward, which the ``engine_spec_verify_dp8`` budget
+    pins."""
+    import jax
+
+    from ..serving import GenerationEngine, SpecConfig, truncated_draft
+    from ..training.sharding import make_mesh
+
+    ge = _graft_entry()
+    _require_devices(n_data)
+    mesh = make_mesh(n_data, 1)
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    dcfg, dparams = truncated_draft(model.config, params, 1)
+    draft_model = type(model)(dcfg)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=2 * n_data,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        mesh=mesh,
+        spec=SpecConfig(model=draft_model, params=dparams, config=dcfg, k=2),
+    )
+    return engine.aot_programs(bucket_len=8, group=2)
+
+
+def canonical_spec_engine_na_programs() -> dict:
+    """The NA speculative-decoding variant, unsharded: the draft chunk runs
+    the full per-event dep-graph level walk on the truncated draft, the
+    verify scores the whole proposed measurement chain teacher-forced in one
+    fused pass (partial-content level embeddings + the per-layer history
+    head) and finishes the correction event's walk. Gated f64-free and
+    host-transfer-free with zero-collective budgets (single device)."""
+    import jax
+
+    from ..data.config import MeasurementConfig
+    from ..serving import GenerationEngine, SpecConfig, truncated_draft
+
+    ge = _graft_entry()
+    # The canonical NA model is a training artifact; generation-side fill
+    # paths additionally need per-measurement configs for the dep-graph
+    # levels' measurements.
+    model, batch = ge._make_model_and_batch(
+        batch_size=2,
+        seq_len=8,
+        na=True,
+        measurement_configs={
+            "lab": MeasurementConfig(
+                name="lab",
+                temporality="dynamic",
+                modality="multivariate_regression",
+                values_column="v",
+            )
+        },
+    )
+    params = model.init(jax.random.PRNGKey(0), batch)
+    dcfg, dparams = truncated_draft(model.config, params, 1)
+    draft_model = type(model)(dcfg)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=4,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        spec=SpecConfig(model=draft_model, params=dparams, config=dcfg, k=2),
+    )
+    programs = engine.aot_programs(bucket_len=8, group=2)
+    # The NA prefill/boundary are structurally the CI spec set's; the NA
+    # census rows gate the two programs with new machinery (the fused
+    # teacher-forced verify and the level-walking draft chunk).
+    return {k: v for k, v in programs.items() if k in ("draft_chunk", "verify")}
+
+
 def canonical_service_programs(n_data: int = 8) -> dict:
     """The online serving service's dispatch programs on the dp8 mesh
     (``serving/service.py``): a 2-replica service whose replicas shard
@@ -579,6 +671,14 @@ def run_program_checks(
     # must stay callback-free.
     for label, (fn, args) in canonical_sampling_engine_program().items():
         programs[f"engine_sampling:{label}"] = (fn, args)
+    # The r13 speculative-decoding programs: the dp8 CI spec engine's
+    # draft-chunk/verify/prefill/boundary set (the verify budget pins "zero
+    # new collective kinds vs the baseline decode") and the NA variant's
+    # draft-chunk/verify pair.
+    for label, (fn, args) in canonical_spec_engine_programs(8).items():
+        programs[f"engine_spec:{label}"] = (fn, args)
+    for label, (fn, args) in canonical_spec_engine_na_programs().items():
+        programs[f"engine_spec_na:{label}"] = (fn, args)
     # The online service's dispatch programs (2-replica service over dp8,
     # deeper decode chunk): the service hot path must stay host-transfer-
     # free beyond the one async boundary fetch — a callback smuggled into
@@ -618,6 +718,11 @@ def run_program_checks(
         budget_keys["engine_kvq:decode"] = "engine_kvq_dp8"
         budget_keys["engine_kvq:prefill_b8"] = "engine_kvq_prefill_dp8"
         budget_keys["engine_sampling:decode"] = "engine_sampling_1dev"
+        budget_keys["engine_spec:draft_chunk"] = "engine_spec_draft_dp8"
+        budget_keys["engine_spec:verify"] = "engine_spec_verify_dp8"
+        budget_keys["engine_spec:prefill_b8"] = "engine_spec_prefill_dp8"
+        budget_keys["engine_spec_na:draft_chunk"] = "engine_spec_na_draft_1dev"
+        budget_keys["engine_spec_na:verify"] = "engine_spec_na_verify_1dev"
         budget_keys["service:decode"] = "service_dp8"
         budget_keys["service:prefill_b8"] = "service_prefill_dp8"
         budget_keys["service:boundary_pack"] = "service_boundary_dp8"

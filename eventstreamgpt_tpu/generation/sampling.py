@@ -59,11 +59,122 @@ def _named_key(key: jax.Array, name: str) -> jax.Array:
     return jax.random.fold_in(key, zlib.crc32(name.encode()) & 0x7FFFFFFF)
 
 
+def _greedy_draw(dist) -> Array:
+    """The deterministic (greedy) draw of a head distribution: categorical
+    heads take their mode, Bernoulli heads threshold at p = 0.5, continuous
+    heads take their mean. Shared by ``sample_predictions(greedy=True)`` and
+    the speculative-decoding accept rule (serving/spec.py), whose greedy
+    bit-identity contract holds *because* both sides call this one
+    function."""
+    if isinstance(dist, Categorical):
+        return dist.mode
+    if isinstance(dist, Bernoulli):
+        return (dist.probs >= 0.5).astype(jnp.float32)
+    return dist.mean
+
+
+def sample_head_draws(
+    preds: GenerativeSequenceModelPredictions,
+    key: jax.Array,
+    categorical_sampler=None,
+    greedy: bool = False,
+) -> dict[str, Array]:
+    """The raw per-head draws behind `sample_predictions`, keyed by the
+    stable head names the named-key derivation already uses (``cls:<m>``,
+    ``cls_obs:<m>``, ``reg:<m>``, ``reg_obs:<m>``, ``tte``).
+
+    Split out so speculative decoding (serving/spec.py) can couple draft
+    and target draws through the SAME keys and inspect the pre-assembly
+    values (the is-observed bit separately from the categorical draw, the
+    raw regression draw before the NaN mask) — with zero drift risk:
+    `sample_predictions` is exactly ``assemble_event_sample(preds,
+    sample_head_draws(...), event_mask)``. Every head's key derives from
+    its name (not draw order), so draw ORDER never affects values.
+    """
+
+    def _draw_categorical(dist: Categorical, k: jax.Array) -> Array:
+        if greedy:
+            return _greedy_draw(dist)
+        if categorical_sampler is not None:
+            return categorical_sampler(dist.logits, k)
+        return dist.sample(k)
+
+    def _draw(dist, k: jax.Array) -> Array:
+        return _greedy_draw(dist) if greedy else dist.sample(k)
+
+    draws: dict[str, Array] = {}
+    if preds.classification is not None:
+        for k, (is_obs_dist, dist) in preds.classification.items():
+            if is_obs_dist is not None:
+                if not isinstance(dist, Categorical):
+                    raise ValueError(f"Don't know how to sample classification dist {dist}!")
+                draws[f"cls_obs:{k}"] = _draw(is_obs_dist, _named_key(key, f"cls_obs:{k}"))
+            if isinstance(dist, Categorical):
+                draws[f"cls:{k}"] = _draw_categorical(dist, _named_key(key, f"cls:{k}"))
+            else:
+                draws[f"cls:{k}"] = _draw(dist, _named_key(key, f"cls:{k}"))
+    if preds.regression is not None:
+        for k, (is_obs_dist, dist) in preds.regression.items():
+            draws[f"reg:{k}"] = _draw(dist, _named_key(key, f"reg:{k}"))
+            if is_obs_dist is not None:
+                draws[f"reg_obs:{k}"] = _draw(is_obs_dist, _named_key(key, f"reg_obs:{k}"))
+    if preds.time_to_event is not None:
+        if greedy:
+            draws["tte"] = preds.time_to_event.mean
+        else:
+            draws["tte"] = preds.time_to_event.sample(_named_key(key, "tte"))
+    return draws
+
+
+def assemble_event_sample(
+    preds: GenerativeSequenceModelPredictions,
+    draws: dict[str, Array],
+    event_mask: Array,
+) -> GenerativeSequenceModelSamples:
+    """Assembles raw head draws (`sample_head_draws`) into an event sample:
+    is-observed gating for single-label classification (unobserved → 0) and
+    regression (unobserved → NaN), and the reference's +inf→1000 TTE clamp."""
+    sampled_classification = None
+    if preds.classification is not None:
+        sampled_classification = {}
+        for k, (is_obs_dist, dist) in preds.classification.items():
+            samp = draws[f"cls:{k}"]
+            if is_obs_dist is None:
+                sampled_classification[k] = samp
+            else:
+                sampled_classification[k] = jnp.where(draws[f"cls_obs:{k}"] == 1, samp, 0)
+
+    sampled_regression = None
+    if preds.regression is not None:
+        sampled_regression = {}
+        for k, (is_obs_dist, dist) in preds.regression.items():
+            samp = draws[f"reg:{k}"]
+            if is_obs_dist is None:
+                sampled_regression[k] = samp
+            else:
+                is_obs = jnp.broadcast_to((draws[f"reg_obs:{k}"] == 1)[..., None], samp.shape)
+                sampled_regression[k] = jnp.where(is_obs, samp, jnp.nan)
+
+    time_to_event = None
+    if preds.time_to_event is not None:
+        # Reference clamps +inf to 1000 (noting its own hack; ``:1155``).
+        time_to_event = jnp.nan_to_num(draws["tte"], posinf=1000.0)
+
+    return GenerativeSequenceModelSamples(
+        event_mask=event_mask,
+        time_to_event=time_to_event,
+        classification=sampled_classification,
+        regression=sampled_regression,
+        regression_indices=preds.regression_indices,
+    )
+
+
 def sample_predictions(
     preds: GenerativeSequenceModelPredictions,
     event_mask: Array,
     key: jax.Array,
     categorical_sampler=None,
+    greedy: bool = False,
 ) -> GenerativeSequenceModelSamples:
     """Samples an event from per-head predictions (reference ``:1093``).
 
@@ -76,56 +187,16 @@ def sample_predictions(
     tail is bit-exact vs ``Categorical.sample`` when unfiltered, so the
     engine's ``generate()`` parity contract survives the swap). ``None``
     keeps the reference multi-op tail.
+
+    ``greedy`` replaces every draw with the head's deterministic statistic
+    (`_greedy_draw`: categorical mode, Bernoulli >= 0.5, continuous mean).
+    ``key`` is then unused; the PRNG chain still advances identically in
+    callers, so flipping the knob never perturbs neighboring draws.
     """
-
-    def _draw_categorical(dist: Categorical, k: jax.Array) -> Array:
-        if categorical_sampler is not None:
-            return categorical_sampler(dist.logits, k)
-        return dist.sample(k)
-
-    sampled_classification = None
-    if preds.classification is not None:
-        sampled_classification = {}
-        for k, (is_obs_dist, dist) in preds.classification.items():
-            if is_obs_dist is None:
-                if isinstance(dist, Categorical):
-                    sampled_classification[k] = _draw_categorical(
-                        dist, _named_key(key, f"cls:{k}")
-                    )
-                else:
-                    sampled_classification[k] = dist.sample(_named_key(key, f"cls:{k}"))
-            elif isinstance(dist, Categorical):
-                is_obs = is_obs_dist.sample(_named_key(key, f"cls_obs:{k}")) == 1
-                samp = _draw_categorical(dist, _named_key(key, f"cls:{k}"))
-                sampled_classification[k] = jnp.where(is_obs, samp, 0)
-            else:
-                raise ValueError(f"Don't know how to sample classification dist {dist}!")
-
-    sampled_regression = None
-    if preds.regression is not None:
-        sampled_regression = {}
-        for k, (is_obs_dist, dist) in preds.regression.items():
-            samp = dist.sample(_named_key(key, f"reg:{k}"))
-            if is_obs_dist is None:
-                sampled_regression[k] = samp
-            else:
-                is_obs = is_obs_dist.sample(_named_key(key, f"reg_obs:{k}")) == 1
-                is_obs = jnp.broadcast_to(is_obs[..., None], samp.shape)
-                sampled_regression[k] = jnp.where(is_obs, samp, jnp.nan)
-
-    time_to_event = None
-    if preds.time_to_event is not None:
-        time_to_event = preds.time_to_event.sample(_named_key(key, "tte"))
-        # Reference clamps +inf to 1000 (noting its own hack; ``:1155``).
-        time_to_event = jnp.nan_to_num(time_to_event, posinf=1000.0)
-
-    return GenerativeSequenceModelSamples(
-        event_mask=event_mask,
-        time_to_event=time_to_event,
-        classification=sampled_classification,
-        regression=sampled_regression,
-        regression_indices=preds.regression_indices,
+    draws = sample_head_draws(
+        preds, key, categorical_sampler=categorical_sampler, greedy=greedy
     )
+    return assemble_event_sample(preds, draws, event_mask)
 
 
 def compact_data_elements(

@@ -94,6 +94,9 @@ class GenerativeSequenceModelOutput:
     past_key_values: Optional[tuple] = None
     hidden_states: Optional[tuple] = None
     attentions: Optional[tuple] = None
+    # NA: per-layer contextualized event embeddings (the spec-verify history
+    # head state; populated only when requested).
+    contextualized: Optional[tuple] = None
 
 
 @struct.dataclass

@@ -207,6 +207,9 @@ class NAPPTForGenerativeSequenceModeling(nn.Module):
         is_generation: bool = False,
         dep_graph_el_generation_target: int | None = None,
         last_event_index: Optional[jnp.ndarray] = None,
+        partial_content_levels: bool = False,
+        history_head: tuple | None = None,
+        return_contextualized: bool = False,
     ) -> GenerativeSequenceModelOutput:
         encoded = self.encoder(
             batch,
@@ -216,6 +219,9 @@ class NAPPTForGenerativeSequenceModeling(nn.Module):
             output_hidden_states=output_hidden_states,
             dep_graph_el_generation_target=dep_graph_el_generation_target,
             last_event_index=last_event_index,
+            partial_content_levels=partial_content_levels,
+            history_head=history_head,
+            return_contextualized=return_contextualized,
         )
         output = self.output_layer(
             batch,
@@ -227,4 +233,5 @@ class NAPPTForGenerativeSequenceModeling(nn.Module):
             past_key_values=encoded.past_key_values,
             hidden_states=encoded.hidden_states,
             attentions=encoded.attentions,
+            contextualized=encoded.contextualized,
         )

@@ -44,6 +44,8 @@ class StructuredAttention(nn.Module):
         prepend_graph_with_history_embeddings: bool = True,
         update_last_graph_el_to_history_embedding: bool = True,
         segment_ids: jnp.ndarray | None = None,  # (B, L): packed subjects
+        history_head: jnp.ndarray | None = None,  # (B, H): position-0 history
+        return_contextualized: bool = False,
     ):
         seq_module_kwargs = seq_module_kwargs or {}
         dep_graph_module_kwargs = dep_graph_module_kwargs or {}
@@ -84,8 +86,20 @@ class StructuredAttention(nn.Module):
             if prepend_graph_with_history_embeddings:
                 # History prior to event i = contextualized event i-1 (zeros
                 # for i=0); prepended as a KV-only graph position.
+                # ``history_head`` overrides the i=0 zeros: a WINDOWED
+                # forward's first event is usually not the subject's first —
+                # the speculative-decoding verify pass injects the previous
+                # committed event's contextualized embedding here (carried
+                # in the engine's spec state like a KV cache), so every
+                # window position sees exactly the history the sequential
+                # walk would.
+                head = (
+                    history_head[:, None, :]
+                    if history_head is not None
+                    else jnp.zeros_like(contextualized_events[:, :1, :])
+                )
                 contextualized_history = jnp.concatenate(
-                    (jnp.zeros_like(contextualized_events[:, :1, :]), contextualized_events[:, :-1, :]),
+                    (head, contextualized_events[:, :-1, :]),
                     axis=1,
                 )
                 if segment_ids is not None:
@@ -120,7 +134,12 @@ class StructuredAttention(nn.Module):
         if event_mask is not None:
             dep_graph_all = jnp.where(event_mask[:, :, None, None], dep_graph_all, 0.0)
 
-        return dep_graph_all, {
+        extra = {
             "seq_module": seq_module_return_kwargs,
             "dep_graph_module": dep_graph_module_return_kwargs,
         }
+        if return_contextualized:
+            extra["contextualized"] = (
+                contextualized_events if compute_contextualized else None
+            )
+        return dep_graph_all, extra

@@ -143,6 +143,10 @@ class TransformerOutputWithPast:
     past_key_values: Optional[tuple] = None
     hidden_states: Optional[tuple] = None
     attentions: Optional[tuple] = None
+    # Per-layer contextualized (whole-event, seq-attended) embeddings of an
+    # NA forward — the speculative-decoding verify's history head state
+    # (requested via return_contextualized; None otherwise).
+    contextualized: Optional[tuple] = None
 
 
 def time_from_deltas(batch: EventStreamBatch) -> Array:
@@ -295,21 +299,37 @@ class InnerSelfAttention(nn.Module):
         present = None
         if layer_past is not None and getattr(layer_past.length, "ndim", 0) == 1:
             # Per-row cache cursors (the serving engine's decode slots): each
-            # row writes its single new key/value at its own ``length[b]``.
-            # Decode chunks are one event wide by construction — a multi-event
-            # chunk would need a per-row *range* scatter.
-            if S != 1:
-                raise NotImplementedError(
-                    "Per-row (vector-length) KV caches support single-event decode "
-                    f"chunks only; got a chunk of {S} events."
-                )
+            # row writes its ``S`` new keys/values starting at its own
+            # ``length[b]``. S == 1 is the decode hot loop (one-hot select,
+            # the r07-audited lowering); S > 1 is the speculative-decoding
+            # verify window (per-row *range* scatter: buffer position ``p``
+            # takes chunk element ``p - start[b]`` via a clipped
+            # take_along_axis gather masked to the written range — a
+            # selection, no arithmetic, so values land bit-identically to S
+            # sequential one-event writes).
             max_len = layer_past.key.shape[2]
             start = layer_past.length  # (B,)
             pos = jnp.arange(max_len)
-            write = pos[None, :] == start[:, None]  # (B, max_len)
-            # key/value are (B, H, 1, D): broadcast over the buffer axis and
-            # write exactly each row's cursor position. The explicit astype
-            # pins the buffer dtype: jnp.where would otherwise silently
+            if S == 1:
+                write = pos[None, :] == start[:, None]  # (B, max_len)
+                gather4 = lambda chunk: chunk  # (B, H, 1, D) broadcasts  # noqa: E731
+                gather3 = lambda chunk: chunk  # (B, H, 1) scale tables  # noqa: E731
+                gather_mask = lambda m: m  # (B, 1)  # noqa: E731
+            else:
+                write = (pos[None, :] >= start[:, None]) & (
+                    pos[None, :] < start[:, None] + S
+                )  # (B, max_len)
+                src = jnp.clip(pos[None, :] - start[:, None], 0, S - 1)  # (B, max_len)
+                gather4 = lambda chunk: jnp.take_along_axis(  # noqa: E731
+                    chunk, src[:, None, :, None], axis=2
+                )
+                gather3 = lambda chunk: jnp.take_along_axis(  # noqa: E731
+                    chunk, src[:, None, :], axis=2
+                )
+                gather_mask = lambda m: jnp.take_along_axis(m, src, axis=1)  # noqa: E731
+            # key/value are (B, H, S, D): broadcast/gather over the buffer
+            # axis and write exactly each row's cursor range. The explicit
+            # astype pins the buffer dtype: jnp.where would otherwise silently
             # promote a narrower cache (bf16 buffers under fp32 compute) to
             # the chunk dtype — the regression `TestKVCacheDtypePreservation`
             # guards. Quantized caches (int8/fp8 + scale tables) instead
@@ -321,26 +341,28 @@ class InnerSelfAttention(nn.Module):
 
                 k_q, k_s = quantize_kv(key, layer_past.key.dtype)
                 v_q, v_s = quantize_kv(value, layer_past.value.dtype)
-                new_key = jnp.where(write[:, None, :, None], k_q, layer_past.key)
-                new_value = jnp.where(write[:, None, :, None], v_q, layer_past.value)
-                new_key_scale = jnp.where(write[:, None, :], k_s, layer_past.key_scale)
+                new_key = jnp.where(write[:, None, :, None], gather4(k_q), layer_past.key)
+                new_value = jnp.where(write[:, None, :, None], gather4(v_q), layer_past.value)
+                new_key_scale = jnp.where(write[:, None, :], gather3(k_s), layer_past.key_scale)
                 new_value_scale = jnp.where(
-                    write[:, None, :], v_s, layer_past.value_scale
+                    write[:, None, :], gather3(v_s), layer_past.value_scale
                 )
             else:
                 new_key = jnp.where(
-                    write[:, None, :, None], key.astype(layer_past.key.dtype), layer_past.key
+                    write[:, None, :, None],
+                    gather4(key.astype(layer_past.key.dtype)),
+                    layer_past.key,
                 )
                 new_value = jnp.where(
                     write[:, None, :, None],
-                    value.astype(layer_past.value.dtype),
+                    gather4(value.astype(layer_past.value.dtype)),
                     layer_past.value,
                 )
                 new_key_scale = new_value_scale = None
             chunk_mask = (
                 attention_mask if attention_mask is not None else jnp.ones((B, S), dtype=bool)
             )
-            new_mask = jnp.where(write, chunk_mask, layer_past.mask)
+            new_mask = jnp.where(write, gather_mask(chunk_mask), layer_past.mask)
             if use_cache:
                 present = KVCache(
                     key=new_key,
@@ -1302,7 +1324,10 @@ class NestedAttentionPointProcessInputLayer(nn.Module):
 
     @nn.compact
     def __call__(
-        self, batch: EventStreamBatch, dep_graph_el_generation_target: int | None = None
+        self,
+        batch: EventStreamBatch,
+        dep_graph_el_generation_target: int | None = None,
+        partial_content_levels: bool = False,
     ) -> Array:
         cfg = self.config
         split_by_measurement_indices = []
@@ -1320,7 +1345,7 @@ class NestedAttentionPointProcessInputLayer(nn.Module):
                     )
             split_by_measurement_indices.append(tuple(out_list))
 
-        embed = DataEmbeddingLayer(
+        embed_layer = DataEmbeddingLayer(
             n_total_embeddings=max(cfg.vocab_size, 1),
             out_dim=cfg.hidden_size,
             categorical_embedding_dim=cfg.categorical_embedding_dim,
@@ -1334,15 +1359,39 @@ class NestedAttentionPointProcessInputLayer(nn.Module):
             numerical_weight=cfg.numerical_embedding_weight,
             compute_dtype=cfg.compute_dtype,
             name="data_embedding_layer",
-        )(batch)
-        # embed: (B, L, G, H)
+        )
 
         t = batch.time if batch.time is not None else time_from_deltas(batch)
         time_embed = TemporalPositionEncoding(embedding_dim=cfg.hidden_size, name="time_embedding_layer")(t)
-        # Time-add + cumsum in fp32 (error compounds over graph levels), then
-        # drop to the compute dtype.
-        embed = embed.astype(jnp.float32).at[:, :, 0, :].add(time_embed)
-        embed = jnp.cumsum(embed, axis=2).astype(cfg.compute_dtype)
+
+        def slots_from(b: EventStreamBatch) -> Array:
+            # Time-add + cumsum in fp32 (error compounds over graph levels),
+            # then drop to the compute dtype.
+            e = embed_layer(b).astype(jnp.float32).at[:, :, 0, :].add(time_embed)
+            return jnp.cumsum(e, axis=2).astype(cfg.compute_dtype)
+
+        if partial_content_levels:
+            # Generation-parity graph slots (speculative-decoding verify):
+            # the cached per-level decode writes graph element ``l``'s
+            # key/value when the event holds ONLY levels <= l — and in JOINT
+            # embedding mode every slot's embedding sums ALL present tokens
+            # (out-of-group tokens at weight 1), so a teacher-forced slot
+            # computed from the finished event differs from what the walk
+            # actually wrote. Rebuild slot ``l`` from the batch with tokens
+            # of later levels masked away (they are plain zero-padding at
+            # walk time, which is exactly what masking produces) — one
+            # embedding pass per level, identical queries/keys to the
+            # sequential walk. Slot G-1 naturally sees the whole event (the
+            # whole-event/contextualization element is built post-walk).
+            lvl_of = na_level_of_measurement(cfg)
+            slots = []
+            for level in range(len(cfg.measurements_per_dep_graph_level)):
+                masked = mask_batch_to_levels(batch, lvl_of, level)
+                slots.append(slots_from(masked)[:, :, level, :])
+            embed = jnp.stack(slots, axis=2)
+        else:
+            embed = slots_from(batch)
+        # embed: (B, L, G, H)
 
         if dep_graph_el_generation_target is not None:
             # Cached generation: only the (target-1)-th graph element is new.
@@ -1360,6 +1409,53 @@ class NAPast:
 
     seq_past: Optional[tuple] = None
     dep_graph_past: Optional[tuple] = None
+
+
+def na_level_of_measurement(config: StructuredTransformerConfig) -> Array:
+    """Static measurement-index -> dep-graph-level lookup table.
+
+    Unlisted measurements (functors, padding index 0) map to level 0 —
+    present from the event's first write. THE one level map for every
+    partial-content consumer (the input layer's
+    ``partial_content_levels``, the spec engine's correction-event strip,
+    and the draft-prefill walk replay): they must agree bit-for-bit or the
+    NA verify exactness contract breaks, hence one builder. Split-mode
+    entries (the same measurement's categorical/numerical halves on
+    different levels) would need element-granular levels — unsupported,
+    loudly.
+    """
+    import numpy as np
+
+    lvl = np.zeros(max(config.measurements_idxmap.values()) + 1, np.int32)
+    for level, meas_list in enumerate(config.measurements_per_dep_graph_level):
+        for m in meas_list:
+            if isinstance(m, (tuple, list)):
+                raise ValueError(
+                    "split-mode (CATEGORICAL_ONLY/NUMERICAL_ONLY) dep-graph "
+                    "levels are not supported by per-level content masking "
+                    f"(speculative decoding) yet; got {m!r}"
+                )
+            lvl[config.measurements_idxmap[m]] = level
+    return jnp.asarray(lvl)
+
+
+def mask_batch_to_levels(
+    batch: EventStreamBatch, level_of_meas: Array, level
+) -> EventStreamBatch:
+    """The batch with dynamic tokens of dep-graph levels > ``level`` masked
+    away (index/measurement -> 0, value -> 0, value mask off) — exactly the
+    zero-padding an in-progress event carries before those levels are
+    written, which is what makes partial-content replays bit-identical to
+    the sequential walk."""
+    keep = level_of_meas[batch.dynamic_measurement_indices] <= level
+    return batch.replace(
+        dynamic_indices=jnp.where(keep, batch.dynamic_indices, 0),
+        dynamic_measurement_indices=jnp.where(
+            keep, batch.dynamic_measurement_indices, 0
+        ),
+        dynamic_values=jnp.where(keep, batch.dynamic_values, 0.0),
+        dynamic_values_mask=batch.dynamic_values_mask & keep,
+    )
 
 
 class NestedAttentionPointProcessTransformer(nn.Module):
@@ -1386,9 +1482,20 @@ class NestedAttentionPointProcessTransformer(nn.Module):
         output_hidden_states: bool = False,
         dep_graph_el_generation_target: int | None = None,
         last_event_index: Array | None = None,
+        partial_content_levels: bool = False,
+        history_head: tuple | None = None,
+        return_contextualized: bool = False,
     ) -> TransformerOutputWithPast:
         cfg = self.config
         segment_ids = batch.segment_ids if batch is not None else None
+        if (history_head is not None or return_contextualized) and getattr(
+            cfg, "scan_layers", False
+        ):
+            raise NotImplementedError(
+                "history_head / return_contextualized (the speculative-decoding "
+                "verify plumbing) require the unrolled layer stack; migrate the "
+                "checkpoint with unstack_layer_params"
+            )
         if segment_ids is not None and (use_cache or past is not None):
             raise NotImplementedError(
                 "Packed (segment_ids) batches do not support KV-cached NA decoding; "
@@ -1397,7 +1504,9 @@ class NestedAttentionPointProcessTransformer(nn.Module):
             )
         if input_embeds is None:
             input_embeds = NestedAttentionPointProcessInputLayer(cfg, name="input_layer")(
-                batch, dep_graph_el_generation_target=dep_graph_el_generation_target
+                batch,
+                dep_graph_el_generation_target=dep_graph_el_generation_target,
+                partial_content_levels=partial_content_levels,
             )
             event_mask = batch.event_mask
         else:
@@ -1497,6 +1606,7 @@ class NestedAttentionPointProcessTransformer(nn.Module):
             if all_hidden is not None:
                 all_hidden = _ungroup_layer_trees(hidden_ys, p, n_groups)
         else:
+            all_contextualized = [] if return_contextualized else None
             for i in range(cfg.num_hidden_layers):
                 if all_hidden is not None:
                     all_hidden.append(hidden_states)
@@ -1508,6 +1618,8 @@ class NestedAttentionPointProcessTransformer(nn.Module):
                     segment_ids=segment_ids,
                     prepend_graph_with_history_embeddings=prepend_graph_with_history_embeddings,
                     update_last_graph_el_to_history_embedding=update_last_graph_el_to_history_embedding,
+                    history_head=history_head[i] if history_head is not None else None,
+                    return_contextualized=return_contextualized,
                     seq_module_kwargs=dict(
                         layer_past=seq_past[i] if seq_past is not None else None,
                         use_cache=update_seq_cache,
@@ -1519,6 +1631,8 @@ class NestedAttentionPointProcessTransformer(nn.Module):
                         output_attentions=output_attentions,
                     ),
                 )
+                if all_contextualized is not None:
+                    all_contextualized.append(extra.get("contextualized"))
 
                 if update_seq_cache:
                     presents_seq.append(extra["seq_module"]["present_key_value"])
@@ -1599,4 +1713,7 @@ class NestedAttentionPointProcessTransformer(nn.Module):
             past_key_values=presents,
             hidden_states=tuple(all_hidden) if all_hidden is not None else None,
             attentions=all_attentions if all_attentions is not None else None,
+            contextualized=(
+                tuple(all_contextualized) if return_contextualized else None
+            ),
         )

@@ -31,6 +31,19 @@ tail while keeping its bit-exact ``generate()`` parity contract
 ``impl`` resolution is shared package-wide (`ops.impl_select`,
 ``$ESGPT_PALLAS_IMPL``); ``"pallas_interpret"`` runs the kernel on any
 backend for CPU CI.
+
+Multi-device mesh rule (r09, re-pinned r13): on meshes with more than one
+device, ``impl in (None, "auto")`` resolves to the fused-XLA tail — the
+kernel's grid slices the slot axis, which is exactly the sharded mesh
+axis, so SPMD would all-gather the ``(n_slots, V)`` logits plane into the
+decode hot loop. Still bit-exact (same gumbel/add/argmax); an explicit
+``"pallas"`` request is honored. The rule must also hold inside the
+speculative-decoding verify forward, whose K-event window samples every
+head through the same tail: the committed ``engine_spec_verify_dp8``
+collective budget pins zero new collective kinds vs the baseline decode
+(``tests/test_graftcheck.py::TestTierB::
+test_spec_verify_budget_has_no_new_collective_kinds``), i.e. no
+logits-plane gather ever reaches the verify hot loop.
 """
 
 from __future__ import annotations

@@ -1,7 +1,8 @@
 """Serving: the continuous-batching engine, the online service over it, and
 the pod-scale fleet (router tier, prefill stream, hot swap) over those."""
 
-from .engine import GenerationEngine, PrefillHandoff, SlotState  # noqa: F401
+from .engine import GenerationEngine, PrefillHandoff, SlotState, SpecState  # noqa: F401
+from .spec import SpecConfig, truncated_draft  # noqa: F401
 from .fleet import FleetResult, PrefillStream, ServingFleet  # noqa: F401
 from .ingest import IngestedSubject, OnlineIngester  # noqa: F401
 from .router import ConsistentHashRouter, stable_hash  # noqa: F401

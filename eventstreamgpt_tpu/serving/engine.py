@@ -75,15 +75,28 @@ from ..generation.generation_utils import (
     _trim_to_event,
 )
 from ..generation.sampling import (
+    GenerativeSequenceModelSamples,
+    _named_key,
     append_new_event,
+    assemble_event_sample,
+    sample_head_draws,
     sample_predictions,
     update_last_event_data,
 )
 from ..generation.stopping_criteria import DeadRowCriteria, DeviceCriterion
 from ..models.config import StructuredEventProcessingMode, StructuredTransformerConfig
-from ..models.transformer import KVCache, NAPast, init_kv_caches
+from ..models.model_output import GenerativeSequenceModelPredictions
+from ..models.transformer import (
+    KVCache,
+    NAPast,
+    init_kv_caches,
+    mask_batch_to_levels,
+    na_level_of_measurement,
+    time_from_deltas,
+)
 from ..ops.tensor_ops import take_event
 from .scheduler import EngineResult, Request, Scheduler, make_buckets
+from .spec import SpecConfig, fold_in_event, select_candidate, spec_accept_level
 
 Array = Any
 
@@ -117,6 +130,30 @@ class SlotState:
     live: Array  # (S,) bool: slot holds an admitted request
     keys: Array  # (S, 2) uint32: per-slot PRNG chains
     active_steps: Array  # () int32: sum over decode steps of active slots
+
+
+@struct.dataclass
+class SpecState:
+    """Device-resident speculative-decoding state carried beside `SlotState`.
+
+    ``draft_caches`` is the draft model's KV-cache pytree at the SAME
+    ``max_len`` as the target's (positions must align; the draft is narrow
+    in width/depth, not in sequence capacity). The counters are per-tenant:
+    admission zeroes a slot's entries, so a finished request's boundary
+    readback carries exactly its own proposal/acceptance totals.
+    """
+
+    draft_caches: Any  # tuple[KVCache] (CI) or NAPast (NA), draft geometry
+    proposed: Array  # (S,) int32: draft events proposed for the resident
+    accepted: Array  # (S,) int32: committed events taken from the draft
+    rounds: Array  # () int32: spec rounds dispatched
+    # NA only: the TARGET model's per-layer contextualized embedding of the
+    # event PRECEDING each slot's last committed event — i.e. the history of
+    # the next verify window's position 0 (the window starts AT the last
+    # committed event, and the NA forward builds histories by shift-right
+    # within its view, so that first position's history must be carried
+    # like a KV cache). Tuple of (S, hidden) per layer; None for CI.
+    history: Any = None
 
 
 @struct.dataclass
@@ -222,6 +259,28 @@ class GenerationEngine:
             every categorical head by the fused tail (serving-quality
             knobs; they deliberately change the sampled distribution, so
             parity vs ``generate()`` holds only when both are ``None``).
+        spec: a `serving.spec.SpecConfig` — enables **speculative decoding**:
+            the draft model proposes ``spec.k`` events per slot per round
+            (its own small KV cache rides beside the target's), the full
+            model verifies all of them in ONE batched forward over the
+            vector-length cache branch, and the accepted prefix (plus one
+            correction/bonus event) commits with per-row cursor advances —
+            no cache rewind copies, rejected tails just stay masked beyond
+            the rolled-back per-row lengths. Sampling runs on the
+            per-event-index PRNG sub-chain (``fold_in(request_key, j)``),
+            so results stay bit-deterministic under placement/chunking/
+            refill order and exact in distribution at any acceptance rate
+            (docs/serving.md "Speculative decoding" for the contracts);
+            ``greedy=True`` spec mode with zero value tolerances commits
+            only the target's own greedy draws — structure/integers
+            bit-identical to the greedy non-speculative engine, floats
+            within the documented last-ulp fusion envelope. Unsupported
+            beside ``top_k``/``top_p``
+            filtering, custom ``device_criteria``, serve-time tensor
+            parallelism, and the dedicated prefill stream (loud errors).
+        greedy: deterministic decoding — every head takes its greedy
+            statistic (categorical mode, Bernoulli >= 0.5, continuous
+            mean) instead of sampling. The PRNG chain is untouched.
         kv_cache_dtype: the decode KV-cache element type. ``None`` keeps
             the model compute dtype (the parity-exact default); ``"bf16"``
             / ``"fp32"`` pin a float width; ``"int8"`` (and ``"fp8"``
@@ -256,10 +315,13 @@ class GenerationEngine:
         top_k: int | None = None,
         top_p: float | None = None,
         kv_cache_dtype: str | None = None,
+        spec: Optional[SpecConfig] = None,
+        greedy: bool = False,
     ):
         self.model = model
         self.params = params
         self.config = config
+        self.greedy = bool(greedy)
         self.n_slots = int(n_slots)
         self.max_len = int(max_len)
         self.decode_chunk = int(decode_chunk)
@@ -352,6 +414,55 @@ class GenerationEngine:
             [{"time"}, *config.measurements_per_dep_graph_level[1:]] if self._is_na else None
         )
 
+        # Speculative decoding (serving/spec.py): the draft model lives
+        # beside the target the way hot-swap shadows do — a second weight
+        # tree plus per-slot draft caches, replicated on serving meshes.
+        self.spec = spec
+        self.draft_params = None
+        if spec is not None:
+            spec.validate_against(config)
+            if self.top_k is not None or self.top_p is not None:
+                raise ValueError(
+                    "speculative decoding does not compose with top_k/top_p "
+                    "filtering: the accept rule needs the heads' unfiltered "
+                    "densities (filtered-pmf support is a follow-up)"
+                )
+            if self.device_criteria:
+                raise ValueError(
+                    "speculative decoding supports the built-in per-row stops "
+                    "(budget, dead rows, max length via budget) only; custom "
+                    "device_criteria cannot be re-evaluated per committed "
+                    "prefix inside the verify program"
+                )
+            if self.tensor_parallel:
+                raise ValueError(
+                    "speculative decoding on tensor-parallel serve meshes is "
+                    "not supported yet; shard slots over 'data' only"
+                )
+            if self._kv_quantized:
+                raise ValueError(
+                    "speculative decoding with a quantized KV cache is not "
+                    "supported: the verify window re-reads freshly written "
+                    "positions, which must be exact for the greedy bit-identity "
+                    "contract"
+                )
+            self.draft_params = spec.params
+            if self._is_na and getattr(config, "scan_layers", False):
+                raise ValueError(
+                    "NA speculative decoding requires the unrolled layer stack "
+                    "(the verify pass threads per-layer history heads); migrate "
+                    "the checkpoint with unstack_layer_params"
+                )
+            if self._is_na:
+                # Static measurement-index -> dep-graph-level map (THE
+                # shared builder — the input layer's partial-content slots,
+                # the correction-event strip, and the draft-prefill walk
+                # replay must agree bit-for-bit): used to strip rejected
+                # levels' stale draft elements before re-filling
+                # (update_last_event_data keeps existing elements by
+                # design). Raises loudly on split-mode levels.
+                self._na_level_of_meas = na_level_of_measurement(config)
+
         self.scheduler = Scheduler(
             self.n_slots,
             make_buckets(min_bucket, self.max_prompt_len),
@@ -360,9 +471,20 @@ class GenerationEngine:
 
         self._template = self._normalize_prompt(template)
         self._state = self._init_state()
+        self._spec_state = self._init_spec_state() if spec is not None else None
         self._param_shardings = None
         if mesh is not None:
             self._state = jax.device_put(self._state, self._state_shardings())
+            if self._spec_state is not None:
+                self._spec_state = jax.device_put(
+                    self._spec_state, self._tree_shardings(self._spec_state)
+                )
+                self.draft_params = jax.device_put(
+                    self.draft_params,
+                    jax.tree_util.tree_map(
+                        lambda _: NamedSharding(mesh, P()), self.draft_params
+                    ),
+                )
             if self.tensor_parallel:
                 from ..training.sharding import make_param_shardings
 
@@ -384,10 +506,15 @@ class GenerationEngine:
 
         # Hot-swap double buffering: a second (shadow) weight buffer the
         # fleet loads the next checkpoint into while this one serves; `flip`
-        # swaps the live pointer at a drained chunk boundary.
+        # swaps the live pointer at a drained chunk boundary. Spec engines
+        # double-buffer the DRAFT weights too — promotion must swap draft
+        # and target atomically or the accept rule would score one
+        # checkpoint's proposals with the other's densities.
         self.hot_swap = bool(hot_swap)
         self._shadow_params = None
+        self._shadow_draft_params = None
         self._swap_reshard_memo = None
+        self._swap_draft_reshard_memo = None
         self.weights_version = 0
 
         # Tensor-parallel layouts pin the output state to the input layout:
@@ -399,13 +526,30 @@ class GenerationEngine:
             self._state_shardings() if self.tensor_parallel else None
         )
         # Compiled-program memos: decode is ONE program; prefill one per
-        # (bucket, group), extract one per group width.
+        # (bucket, group), extract one per group width. Spec mode replaces
+        # the decode program with the draft-chunk + verify pair (one round
+        # = one dispatch of each; ISSUE 13's `engine_spec:draft_chunk` /
+        # `engine_spec:verify` census programs).
         self._decode_jit = jax.jit(
             self._decode_chunk_na if self._is_na else self._decode_chunk_ci,
             donate_argnums=(1,),
             out_shardings=self._state_out_shardings,
         )
+        if spec is not None:
+            self._spec_draft_jit = jax.jit(
+                self._spec_draft_chunk_na if self._is_na else self._spec_draft_chunk_ci,
+                donate_argnums=(1, 2),
+            )
+            # The proposal buffers (arg 3) are consumed here but alias no
+            # output shape, so donating them would be a no-op the Tier C
+            # donation audit rightly flags; they die after the call either
+            # way.
+            self._spec_verify_jit = jax.jit(
+                self._spec_verify_na if self._is_na else self._spec_verify_ci,
+                donate_argnums=(1, 2),
+            )
         self._prefill_jits: dict[tuple[int, int], Any] = {}
+        self._prefill_spec_jits: dict[tuple[int, int], Any] = {}
         # Prefill-stream split programs: the bucketed prefill forward with no
         # slot scatter (runs on a dedicated prefill replica) and the admit
         # scatter alone (runs on the decode replica receiving the handoff).
@@ -413,17 +557,34 @@ class GenerationEngine:
         self._admit_jits: dict[int, Any] = {}
         self._extract_jits: dict[int, Any] = {}
         # Packs done/cursor/base_len/n_generated into ONE (4, n_slots)
-        # array so the boundary readback is a single async host copy.
-        self._pack_boundary_jit = jax.jit(
-            lambda st: jnp.stack(
-                [
-                    st.done.astype(jnp.int32),
-                    st.cursor,
-                    st.base_len,
-                    st.n_generated,
-                ]
+        # array so the boundary readback is a single async host copy. Spec
+        # engines pack (6, n_slots): the per-tenant proposed/accepted
+        # counters ride the same copy, so per-request acceptance accounting
+        # costs zero extra transfers.
+        if spec is None:
+            self._pack_boundary_jit = jax.jit(
+                lambda st: jnp.stack(
+                    [
+                        st.done.astype(jnp.int32),
+                        st.cursor,
+                        st.base_len,
+                        st.n_generated,
+                    ]
+                )
             )
-        )
+        else:
+            self._pack_boundary_jit = jax.jit(
+                lambda st, sp: jnp.stack(
+                    [
+                        st.done.astype(jnp.int32),
+                        st.cursor,
+                        st.base_len,
+                        st.n_generated,
+                        sp.proposed,
+                        sp.accepted,
+                    ]
+                )
+            )
 
         # Host-side slot table: slot -> Request or None. `live`/`done` on
         # device gate compute; occupancy/harvest bookkeeping lives here.
@@ -511,7 +672,50 @@ class GenerationEngine:
             active_steps=jnp.zeros((), jnp.int32),
         )
 
-    def _state_shardings(self):
+    def _init_spec_state(self) -> SpecState:
+        """Preallocates the draft model's per-slot caches + spec counters.
+
+        The draft caches share the target's ``max_len`` (positions must
+        align between the two chains) at the draft's own width/depth — the
+        capacity cost `slots_report` accounts per slot.
+        """
+        S, L = self.n_slots, self.max_len
+        dcfg = self.spec.config
+        seq = tuple(
+            kv.replace(length=jnp.zeros((S,), jnp.int32))
+            for kv in init_kv_caches(dcfg, S, max_len=L)
+        )
+        if self._is_na:
+            n_levels = len(self._measurements_to_fill_list)
+            max_dep_len = len(dcfg.measurements_per_dep_graph_level) + 1
+            dep = tuple(
+                KVCache.init(
+                    S,
+                    dcfg.num_attention_heads,
+                    max_dep_len,
+                    dcfg.head_dim,
+                    dtype=dcfg.compute_dtype,
+                ).replace(length=jnp.asarray(n_levels, jnp.int32))
+                for _ in range(dcfg.num_hidden_layers)
+            )
+            caches = NAPast(seq_past=seq, dep_graph_past=dep)
+        else:
+            caches = seq
+        history = None
+        if self._is_na:
+            history = tuple(
+                jnp.zeros((S, self.config.hidden_size), self.config.compute_dtype)
+                for _ in range(self.config.num_hidden_layers)
+            )
+        return SpecState(
+            draft_caches=caches,
+            proposed=jnp.zeros((S,), jnp.int32),
+            accepted=jnp.zeros((S,), jnp.int32),
+            rounds=jnp.zeros((), jnp.int32),
+            history=history,
+        )
+
+    def _tree_shardings(self, tree):
         mesh = self.mesh
 
         def spec(x):
@@ -519,7 +723,10 @@ class GenerationEngine:
                 return NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
             return NamedSharding(mesh, P())
 
-        return jax.tree_util.tree_map(spec, self._state)
+        return jax.tree_util.tree_map(spec, tree)
+
+    def _state_shardings(self):
+        return self._tree_shardings(self._state)
 
     # --------------------------------------------------------- device pieces
     def _sample_rows(self, preds_last, em_last, step_keys, active=None):
@@ -534,8 +741,12 @@ class GenerationEngine:
         multi-op tail when ``top_k``/``top_p`` are off.
         """
         base = self._categorical_sampler
-        if base is None:
-            return jax.vmap(sample_predictions)(preds_last, em_last, step_keys)
+        greedy = self.greedy
+        if base is None or greedy:
+            row = lambda p, e, k: sample_predictions(  # noqa: E731
+                p, e, k, categorical_sampler=None if greedy else base, greedy=greedy
+            )
+            return jax.vmap(row)(preds_last, em_last, step_keys)
         if active is None:
             row = lambda p, e, k: sample_predictions(  # noqa: E731
                 p, e, k, categorical_sampler=base
@@ -547,6 +758,18 @@ class GenerationEngine:
             return sample_predictions(p, e, k, categorical_sampler=sampler)
 
         return jax.vmap(row_active)(preds_last, em_last, step_keys, active)
+
+    def _draw_rows(self, preds_last, keys):
+        """Per-row raw named-head draws (`sample_head_draws`) — the spec
+        paths' sampling primitive: draft proposals, verify target draws,
+        and the correction walk all come through here, so the coupling
+        (same keys, same sampler family) is structural."""
+        base = self._categorical_sampler
+        greedy = self.greedy
+        row = lambda p, k: sample_head_draws(  # noqa: E731
+            p, k, categorical_sampler=None if greedy else base, greedy=greedy
+        )
+        return jax.vmap(row)(preds_last, keys)
 
     def _row_done(self, big, cursor, base_len, n_generated, budget):
         done = (cursor - base_len) >= budget
@@ -698,6 +921,548 @@ class GenerationEngine:
         state, _ = jax.lax.scan(body, state, None, length=self.decode_chunk)
         return state
 
+    # ------------------------------------------------- speculative decoding
+    def _window_view(self, big: EventStreamBatch, start, W: int) -> EventStreamBatch:
+        """A ``W``-event view of the slot rows starting at per-row position
+        ``start`` — the verify window. Built from per-offset `take_event`
+        stacks with absolute time from the full row buffer, so every window
+        position is bitwise the one-event view `_trim_to_event` builds
+        there (the greedy bit-identity contract's input half)."""
+        t_full = time_from_deltas(big)
+
+        def take(x):
+            return jnp.stack([take_event(x, start + t) for t in range(W)], axis=1)
+
+        return big.replace(
+            event_mask=take(big.event_mask),
+            time_delta=take(big.time_delta),
+            time=take(t_full),
+            dynamic_indices=take(big.dynamic_indices),
+            dynamic_measurement_indices=take(big.dynamic_measurement_indices),
+            dynamic_values=take(big.dynamic_values),
+            dynamic_values_mask=take(big.dynamic_values_mask),
+        )
+
+    def _level_keys(self, base_keys, level: int):
+        """Per-row level sub-keys of the event-index base chain (NA)."""
+        return jax.vmap(lambda k: _named_key(k, f"level:{level}"))(base_keys)
+
+    def _level_preds(self, preds, level: int):
+        """The dep-graph level's head subset of a full NA forward's preds —
+        exactly the dists the per-level generation forward would expose
+        (mirrors `NestedAttentionGenerativeOutputLayer`'s level loop,
+        including CATEGORICAL_ONLY/NUMERICAL_ONLY split modes)."""
+        from ..models.embedding import MeasIndexGroupOptions
+
+        if level == 0:
+            return GenerativeSequenceModelPredictions(
+                time_to_event=preds.time_to_event
+            )
+        cat, num = set(), set()
+        for m in self.config.measurements_per_dep_graph_level[level]:
+            mode = MeasIndexGroupOptions.CATEGORICAL_AND_NUMERICAL
+            if isinstance(m, (tuple, list)):
+                m, mode = m
+            if mode in (
+                MeasIndexGroupOptions.CATEGORICAL_AND_NUMERICAL,
+                MeasIndexGroupOptions.CATEGORICAL_ONLY,
+            ):
+                cat.add(m)
+            if mode in (
+                MeasIndexGroupOptions.CATEGORICAL_AND_NUMERICAL,
+                MeasIndexGroupOptions.NUMERICAL_ONLY,
+            ):
+                num.add(m)
+        cls = {m: d for m, d in (preds.classification or {}).items() if m in cat}
+        reg = {m: d for m, d in (preds.regression or {}).items() if m in num}
+        return GenerativeSequenceModelPredictions(
+            classification=cls or None, regression=reg or None
+        )
+
+    def _level_fill_set(self, level: int):
+        return set(
+            tuple(sorted(self._measurements_to_fill_list[level], key=str))
+        )
+
+    def _spec_draft_chunk_ci(self, draft_params, st: SlotState, sp: SpecState):
+        """K draft proposals per slot, written into the row buffers beyond
+        the committed cursor. Frozen (done/empty) slots are merged back to
+        their pre-round state — proposals for them are inert scratch."""
+        config, K = self.config, self.spec.k
+        active = st.live & ~st.done
+
+        def body(carry, t):
+            big, caches = carry
+            pos = st.cursor + t  # the proposed event's row position
+            view = _trim_to_event(big, pos - 1)
+            out = self.spec.model.apply(
+                draft_params, view, past=caches, use_cache=True, is_generation=True
+            )
+            preds_last = _slice_preds_at(out.preds, jnp.asarray(0))
+            em_last = take_event(big.event_mask, pos - 1)
+            keys = fold_in_event(st.keys, pos - st.base_len)
+            draws = self._draw_rows(preds_last, keys)
+            sample = assemble_event_sample(preds_last, draws, em_last)
+            big = append_new_event(big, sample, config, pos)
+            big = update_last_event_data(big, sample, config, pos + 1)
+            return (big, out.past_key_values), (preds_last, draws)
+
+        (big, dcaches), proposals = jax.lax.scan(
+            body, (st.big, sp.draft_caches), jnp.arange(K)
+        )
+        big = self._merge_rows(active, big, st.big)
+        dcaches = self._merge_caches(active, dcaches, sp.draft_caches)
+        return st.replace(big=big), sp.replace(draft_caches=dcaches), proposals
+
+    def _spec_draft_chunk_na(self, draft_params, st: SlotState, sp: SpecState):
+        """The NA draft chunk: K full per-event dep-graph level walks on the
+        draft model, recording per-level predictions + raw draws — the
+        second speculation axis (the verify pass scores the whole proposed
+        measurement chain teacher-forced in one fused forward)."""
+        config, K = self.config, self.spec.k
+        n_levels = len(self._measurements_to_fill_list)
+        active = st.live & ~st.done
+
+        def body(carry, t):
+            big, past = carry
+            pos = st.cursor + t
+            base = fold_in_event(st.keys, pos - st.base_len)
+            view = _trim_to_event(big, pos - 1)
+            out = self.spec.model.apply(
+                draft_params,
+                view,
+                past=past,
+                use_cache=True,
+                is_generation=True,
+                dep_graph_el_generation_target=0,
+            )
+            preds0 = _slice_preds_at(out.preds, jnp.asarray(0))
+            em0 = take_event(big.event_mask, pos - 1)
+            draws0 = self._draw_rows(preds0, self._level_keys(base, 0))
+            sample0 = assemble_event_sample(preds0, draws0, em0)
+            big = append_new_event(big, sample0, config, pos)
+            past = out.past_key_values
+            ys = [(preds0, draws0)]
+            for level in range(1, n_levels):
+                view = _trim_to_event(big, pos)
+                out = self.spec.model.apply(
+                    draft_params,
+                    view,
+                    past=past,
+                    use_cache=True,
+                    is_generation=True,
+                    dep_graph_el_generation_target=level,
+                )
+                past = out.past_key_values
+                preds_l = _slice_preds_at(out.preds, jnp.asarray(0))
+                em_l = take_event(big.event_mask, pos)
+                draws_l = self._draw_rows(preds_l, self._level_keys(base, level))
+                sample_l = assemble_event_sample(preds_l, draws_l, em_l)
+                big = update_last_event_data(
+                    big,
+                    sample_l,
+                    config,
+                    pos + 1,
+                    measurements_to_fill=self._level_fill_set(level),
+                )
+                ys.append((preds_l, draws_l))
+            return (big, past), tuple(ys)
+
+        (big, dpast), proposals = jax.lax.scan(
+            body, (st.big, sp.draft_caches), jnp.arange(K)
+        )
+        big = self._merge_rows(active, big, st.big)
+        dpast = self._merge_caches(active, dpast, sp.draft_caches)
+        return st.replace(big=big), sp.replace(draft_caches=dpast), proposals
+
+    def _spec_round_caps(self, st: SlotState, a, prop_em):
+        """Commit-count math shared by both verify programs: acceptance
+        (``a + 1`` — accepted prefix plus the correction/bonus event),
+        capped by the per-row decode budget and — mirroring the baseline's
+        event-at-a-time stopping — at the first committed dead event
+        (`DeadRowCriteria` semantics: the dead event commits, nothing
+        after it)."""
+        K = self.spec.k
+        budget_left = st.budget - (st.cursor - st.base_len)
+        m = jnp.minimum(a + 1, budget_left)
+        if self.stop_dead_rows:
+            f = jnp.cumprod(prop_em.astype(jnp.int32), axis=0).sum(0)
+            m = jnp.minimum(m, jnp.where(f < a, f + 1, K + 2))
+        m = jnp.maximum(m, 1)
+        return m, m == a + 1
+
+    def _spec_advance(self, st, sp, active, big, m, needs_corr):
+        """Post-commit slot-state advance shared by both verify programs
+        (callers have already merged committed content into ``big`` and set
+        cache lengths)."""
+        c = st.cursor
+        m_act = jnp.where(active, m, 0)
+        cursor = c + m_act
+        positions = jnp.arange(self.max_len)[None, :]
+        new_real = (
+            big.event_mask & (positions >= c[:, None]) & (positions < cursor[:, None])
+        ).sum(1)
+        n_generated = st.n_generated + jnp.where(active, new_real, 0)
+        done = st.done | (
+            active
+            & self._row_done(big, cursor, st.base_len, n_generated, st.budget)
+        )
+        accepted_now = m - needs_corr.astype(jnp.int32)
+        # Proposals beyond a row's remaining budget can never commit; count
+        # only the committable ones, so the acceptance rate measures draft
+        # quality rather than budget truncation.
+        budget_left = st.budget - (c - st.base_len)
+        proposable = jnp.minimum(self.spec.k, jnp.maximum(budget_left, 0))
+        sp = sp.replace(
+            proposed=sp.proposed + jnp.where(active, proposable, 0),
+            accepted=sp.accepted + jnp.where(active, accepted_now, 0),
+            rounds=sp.rounds + 1,
+        )
+        st = st.replace(
+            big=big,
+            cursor=cursor,
+            n_generated=n_generated,
+            done=done,
+            active_steps=st.active_steps + active.sum(),
+        )
+        return st, sp
+
+    def _spec_verify_ci(self, params, st: SlotState, sp: SpecState, proposals):
+        """ONE batched target forward over the K+1-event window (last
+        committed event + all K proposals) on the vector-length cache
+        branch scores every proposal; the accept walk commits the accepted
+        prefix plus a correction/bonus event, and per-row cache lengths
+        roll back over rejected tails — no copies."""
+        config, K = self.config, self.spec.k
+        W = K + 1
+        active = st.live & ~st.done
+        c = st.cursor
+        preds_k, draws_k = proposals
+
+        view = self._window_view(st.big, c - 1, W)
+        out = self.model.apply(
+            params, view, past=st.caches, use_cache=True, is_generation=True
+        )
+
+        accept_fn = functools.partial(
+            spec_accept_level,
+            greedy=self.greedy,
+            rtol=self.spec.value_rtol,
+            atol=self.spec.value_atol,
+        )
+        accepts, cands = [], []
+        for t in range(1, K + 1):
+            tgt_preds_t = jax.tree_util.tree_map(lambda x: x[:, t - 1], out.preds)
+            dft_preds_t = jax.tree_util.tree_map(lambda x: x[t - 1], preds_k)
+            dft_draws_t = jax.tree_util.tree_map(lambda x: x[t - 1], draws_k)
+            em_t = take_event(st.big.event_mask, c + t - 2)
+            keys_t = fold_in_event(st.keys, (c + t - 1) - st.base_len)
+            tgt_draws_t = self._draw_rows(tgt_preds_t, keys_t)
+            acc_t, cand_t = jax.vmap(accept_fn)(
+                tgt_preds_t, dft_preds_t, dft_draws_t, tgt_draws_t, keys_t, em_t
+            )
+            accepts.append(acc_t)
+            cands.append(cand_t)
+        # The bonus candidate: a pure target sample off the verify
+        # forward's last position — the event a fully-accepted round
+        # commits for free.
+        tgt_preds_b = jax.tree_util.tree_map(lambda x: x[:, K], out.preds)
+        em_b = take_event(st.big.event_mask, c + K - 1)
+        keys_b = fold_in_event(st.keys, (c + K) - st.base_len)
+        cands.append(
+            assemble_event_sample(
+                tgt_preds_b, self._draw_rows(tgt_preds_b, keys_b), em_b
+            )
+        )
+
+        a = jnp.cumprod(jnp.stack(accepts, 0).astype(jnp.int32), axis=0).sum(0)
+        prop_em = jnp.stack(
+            [take_event(st.big.event_mask, c + t - 1) for t in range(1, K + 1)], 0
+        )
+        m, needs_corr = self._spec_round_caps(st, a, prop_em)
+
+        corr_sample = select_candidate(cands, a)
+        corr_cursor = c + m - 1
+        big1 = append_new_event(st.big, corr_sample, config, corr_cursor)
+        big1 = update_last_event_data(big1, corr_sample, config, corr_cursor + 1)
+        big = self._merge_rows(active & needs_corr, big1, st.big)
+
+        st2, sp2 = self._spec_advance(st, sp, active, big, m, needs_corr)
+        caches = self._merge_caches(active, out.past_key_values, st.caches)
+        caches = tuple(
+            kv.replace(length=jnp.where(active, st2.cursor - 1, kv.length))
+            for kv in caches
+        )
+        dcaches = tuple(
+            kv.replace(length=jnp.where(active, st2.cursor - 1, kv.length))
+            for kv in sp2.draft_caches
+        )
+        return st2.replace(caches=caches), sp2.replace(draft_caches=dcaches)
+
+    def _spec_verify_na(self, params, st: SlotState, sp: SpecState, proposals):
+        """The NA verify: ONE fused teacher-forced full forward (target=None
+        on the vector cache branch) scores the whole proposed dep-graph
+        measurement chain of all K events; the correction/bonus event then
+        finishes its level walk sequentially (one re-contextualize forward
+        plus the standard per-level decodes, per-row frozen at the levels
+        the draft already got right).
+
+        Two pieces make the one fused pass EXACT against the sequential
+        cached walk: ``partial_content_levels`` embeds graph slot ``l`` from
+        the event's levels <= l (what the walk actually wrote — in JOINT
+        embedding mode every slot sums all present tokens), and
+        ``history_head`` injects each slot's carried per-layer history
+        embedding at the window's first position (the NA forward builds
+        histories by shift-right within its view; a zero there would poison
+        every deeper layer's keys). The round's own contextualized outputs
+        refresh the history state for the next round."""
+        config, K = self.config, self.spec.k
+        W = K + 1
+        n_levels = len(self._measurements_to_fill_list)
+        active = st.live & ~st.done
+        c = st.cursor
+
+        view = self._window_view(st.big, c - 1, W)
+        out = self.model.apply(
+            params,
+            view,
+            past=NAPast(seq_past=st.caches.seq_past, dep_graph_past=None),
+            use_cache=True,
+            is_generation=True,
+            partial_content_levels=True,
+            history_head=sp.history,
+            return_contextualized=True,
+        )
+
+        accept_fn = functools.partial(
+            spec_accept_level,
+            greedy=self.greedy,
+            rtol=self.spec.value_rtol,
+            atol=self.spec.value_atol,
+        )
+        acc_events, lrejs = [], []
+        level_cands = [[] for _ in range(n_levels)]
+        for t in range(1, K + 1):
+            base_t = fold_in_event(st.keys, (c + t - 1) - st.base_len)
+            level_accs = []
+            for level in range(n_levels):
+                # Level 0 (the TTE/append chain link) is predicted by the
+                # PRECEDING position's whole-event encoding; levels >= 1 by
+                # the event's own teacher-forced graph encodings. View index
+                # v holds absolute position c - 1 + v.
+                src = t - 1 if level == 0 else t
+                tgt_preds_l = self._level_preds(
+                    jax.tree_util.tree_map(lambda x, s=src: x[:, s], out.preds), level
+                )
+                dft_preds_l = jax.tree_util.tree_map(
+                    lambda x: x[t - 1], proposals[level][0]
+                )
+                dft_draws_l = jax.tree_util.tree_map(
+                    lambda x: x[t - 1], proposals[level][1]
+                )
+                em_l = take_event(
+                    st.big.event_mask, c + t - 2 if level == 0 else c + t - 1
+                )
+                keys_l = self._level_keys(base_t, level)
+                tgt_draws_l = self._draw_rows(tgt_preds_l, keys_l)
+                acc_l, cand_l = jax.vmap(accept_fn)(
+                    tgt_preds_l, dft_preds_l, dft_draws_l, tgt_draws_l, keys_l, em_l
+                )
+                level_accs.append(acc_l)
+                level_cands[level].append(cand_l)
+            acc_stack = jnp.stack(level_accs, 0).astype(jnp.int32)  # (n_levels, S)
+            lrejs.append(jnp.cumprod(acc_stack, axis=0).sum(0))  # first reject level
+            acc_events.append(acc_stack.prod(0).astype(bool))
+        # Bonus level-0 candidate (the fully-accepted round's free event):
+        # target TTE off the last view position; its fill levels come from
+        # the correction walk below, so levels >= 1 reuse the last
+        # candidate as an inert placeholder (never selected).
+        tgt_preds_b = self._level_preds(
+            jax.tree_util.tree_map(lambda x: x[:, K], out.preds), 0
+        )
+        em_b = take_event(st.big.event_mask, c + K - 1)
+        base_b = fold_in_event(st.keys, (c + K) - st.base_len)
+        level_cands[0].append(
+            assemble_event_sample(
+                tgt_preds_b, self._draw_rows(tgt_preds_b, self._level_keys(base_b, 0)), em_b
+            )
+        )
+        for level in range(1, n_levels):
+            level_cands[level].append(level_cands[level][-1])
+
+        a = jnp.cumprod(jnp.stack(acc_events, 0).astype(jnp.int32), axis=0).sum(0)
+        prop_em = jnp.stack(
+            [take_event(st.big.event_mask, c + t - 1) for t in range(1, K + 1)], 0
+        )
+        m, needs_corr = self._spec_round_caps(st, a, prop_em)
+        # The correction event's first level to resample: its own rejection
+        # level, or 0 for the bonus event (whose whole walk is fresh).
+        lrej_stack = jnp.stack(lrejs, 0)  # (K, S)
+        l_sel = jnp.where(
+            a < K,
+            jnp.take_along_axis(lrej_stack, jnp.minimum(a, K - 1)[None, :], axis=0)[0],
+            0,
+        )
+        corr_cursor = c + m - 1
+
+        # Commit the correction event's verify-side pieces: level 0 (append)
+        # when the chain broke at/under level 0, and the breaking level's
+        # residual fill for levels >= 1. Levels BELOW the break keep the
+        # draft's content already in the row.
+        big = st.big
+        cand0 = select_candidate(level_cands[0], a)
+        big1 = append_new_event(big, cand0, config, corr_cursor)
+        big = self._merge_rows(active & needs_corr & (l_sel == 0), big1, big)
+        # Chain broke mid-walk (l_sel >= 1): strip the rejected levels'
+        # stale draft elements from the correction event before re-filling
+        # (append resets the element set only on the l_sel == 0 path;
+        # update_last_event_data keeps existing elements by design). The
+        # accepted levels' elements survive in their build order — the
+        # stable compaction of the fills below reproduces a baseline-built
+        # event's layout exactly.
+        bcols = jnp.arange(self.n_slots)
+        meas_at = big.dynamic_measurement_indices[bcols, corr_cursor]
+        el_level = self._na_level_of_meas[meas_at]  # (S, M)
+        drop = (meas_at != 0) & (el_level >= l_sel[:, None])
+        strip = (active & needs_corr & (l_sel >= 1))[:, None] & drop
+        stripped_idx = jnp.where(strip, 0, big.dynamic_indices[bcols, corr_cursor])
+        stripped_meas = jnp.where(strip, 0, meas_at)
+        stripped_val = jnp.where(strip, 0.0, big.dynamic_values[bcols, corr_cursor])
+        stripped_vmask = jnp.where(
+            strip, False, big.dynamic_values_mask[bcols, corr_cursor]
+        )
+        big = big.replace(
+            dynamic_indices=big.dynamic_indices.at[bcols, corr_cursor].set(stripped_idx),
+            dynamic_measurement_indices=big.dynamic_measurement_indices.at[
+                bcols, corr_cursor
+            ].set(stripped_meas),
+            dynamic_values=big.dynamic_values.at[bcols, corr_cursor].set(stripped_val),
+            dynamic_values_mask=big.dynamic_values_mask.at[bcols, corr_cursor].set(
+                stripped_vmask
+            ),
+        )
+        for level in range(1, n_levels):
+            cand_l = select_candidate(level_cands[level], jnp.minimum(a, K - 1))
+            big1 = update_last_event_data(
+                big,
+                cand_l,
+                config,
+                corr_cursor + 1,
+                measurements_to_fill=self._level_fill_set(level),
+            )
+            big = self._merge_rows(active & needs_corr & (l_sel == level), big1, big)
+
+        # The correction walk: re-contextualize the predecessor (a one-event
+        # full forward — rebuilds the dep-graph cache seed exactly as
+        # admission prefill does) then decode levels above the break with
+        # the standard per-level programs, per-row frozen where the draft's
+        # levels stand.
+        needs_walk = active & needs_corr
+        seq_merged = self._merge_rows(active, out.past_key_values.seq_past, st.caches.seq_past)
+        seq_walk_in = tuple(
+            kv.replace(
+                length=jnp.where(
+                    needs_walk,
+                    corr_cursor - 1,
+                    jnp.where(active, c + m - 1, kv.length),
+                )
+            )
+            for kv in seq_merged
+        )
+        # History head for the re-contextualize forward: the event BEFORE
+        # the correction event — the round's input history when the very
+        # first proposal broke (a == 0), else the in-window contextualized
+        # embedding of the last accepted proposal.
+        hist_r = tuple(
+            jnp.where(
+                (a == 0)[:, None],
+                sp.history[layer],
+                jnp.take_along_axis(
+                    ctx, jnp.clip(a - 1, 0, W - 1)[:, None, None], axis=1
+                )[:, 0],
+            )
+            for layer, ctx in enumerate(out.contextualized)
+        )
+        view_r = _trim_to_event(big, corr_cursor - 1)
+        out_r = self.model.apply(
+            params,
+            view_r,
+            past=NAPast(seq_past=seq_walk_in, dep_graph_past=None),
+            use_cache=True,
+            is_generation=True,
+            partial_content_levels=True,
+            history_head=hist_r,
+        )
+        walk_past = out_r.past_key_values
+        base_corr = fold_in_event(st.keys, corr_cursor - st.base_len)
+        for level in range(1, n_levels):
+            view_l = _trim_to_event(big, corr_cursor)
+            out_l = self.model.apply(
+                params,
+                view_l,
+                past=walk_past,
+                use_cache=True,
+                is_generation=True,
+                dep_graph_el_generation_target=level,
+            )
+            walk_past = out_l.past_key_values
+            preds_l = _slice_preds_at(out_l.preds, jnp.asarray(0))
+            em_l = take_event(big.event_mask, corr_cursor)
+            draws_l = self._draw_rows(preds_l, self._level_keys(base_corr, level))
+            sample_l = assemble_event_sample(preds_l, draws_l, em_l)
+            big1 = update_last_event_data(
+                big,
+                sample_l,
+                config,
+                corr_cursor + 1,
+                measurements_to_fill=self._level_fill_set(level),
+            )
+            big = self._merge_rows(needs_walk & (l_sel < level), big1, big)
+
+        st2, sp2 = self._spec_advance(st, sp, active, big, m, needs_corr)
+        # Seq caches: walk rows take the re-contextualize forward's write at
+        # the correction position; everyone else keeps the verify pass's.
+        # Final per-row length is uniformly cursor' - 1 (the baseline decode
+        # invariant); rejected-tail junk sits beyond it, masked.
+        seq_final = tuple(
+            self._merge_rows(needs_walk, w, s)
+            for w, s in zip(walk_past.seq_past, seq_walk_in)
+        )
+        seq_final = tuple(
+            kv.replace(length=jnp.where(active, st2.cursor - 1, kv.length))
+            for kv in seq_final
+        )
+        dep_final = walk_past.dep_graph_past  # lockstep scratch (spec mode
+        # never reads dep caches across rounds: verify and the walk's
+        # re-contextualize forward both rebuild the seed from content)
+        dseq = tuple(
+            kv.replace(length=jnp.where(active, st2.cursor - 1, kv.length))
+            for kv in sp2.draft_caches.seq_past
+        )
+        # Refresh the history head: the next round's window starts at the
+        # new last committed event, whose PREDECESSOR (absolute c + m - 2 =
+        # window index m - 1, always committed content) supplies position-0
+        # history.
+        history = tuple(
+            jnp.where(
+                active[:, None],
+                jnp.take_along_axis(
+                    ctx, jnp.clip(m - 1, 0, W - 1)[:, None, None], axis=1
+                )[:, 0],
+                sp.history[layer],
+            )
+            for layer, ctx in enumerate(out.contextualized)
+        )
+        return (
+            st2.replace(caches=NAPast(seq_past=seq_final, dep_graph_past=dep_final)),
+            sp2.replace(
+                draft_caches=NAPast(
+                    seq_past=dseq, dep_graph_past=sp2.draft_caches.dep_graph_past
+                ),
+                history=history,
+            ),
+        )
+
     # ------------------------------------------------------------- prefill
     def _prefill_jit(self, bucket_len: int, group: int):
         key = (bucket_len, group)
@@ -835,6 +1600,59 @@ class GenerationEngine:
             )
         return big, past, new_keys, first_event_real
 
+    def _scatter_kv(
+        self, dst: KVCache, src: KVCache, vector_len: bool, slots, plen
+    ) -> KVCache:
+        """One prefilled cache's rows scattered into the slot cache (the
+        admission write; shared by the target and draft admits)."""
+        if dst.key_scale is not None:
+            # Quantize-on-admission: prefill ran (exactly) on float
+            # caches; the admitted rows land in the slot cache as
+            # int8/fp8 planes + per-head-per-row scales (ops/kv_quant).
+            from ..ops.kv_quant import quantize_kv
+
+            k_q, k_s = quantize_kv(src.key, dst.key.dtype)
+            v_q, v_s = quantize_kv(src.value, dst.value.dtype)
+            key = dst.key.at[slots].set(k_q, mode="drop")
+            value = dst.value.at[slots].set(v_q, mode="drop")
+            key_scale = dst.key_scale.at[slots].set(k_s, mode="drop")
+            value_scale = dst.value_scale.at[slots].set(v_s, mode="drop")
+        else:
+            key = dst.key.at[slots].set(src.key.astype(dst.key.dtype), mode="drop")
+            value = dst.value.at[slots].set(
+                src.value.astype(dst.value.dtype), mode="drop"
+            )
+            key_scale = value_scale = None
+        return KVCache(
+            key=key,
+            value=value,
+            mask=dst.mask.at[slots].set(src.mask, mode="drop"),
+            length=(
+                dst.length.at[slots].set(plen, mode="drop")
+                if vector_len
+                else src.length
+            ),
+            key_scale=key_scale,
+            value_scale=value_scale,
+        )
+
+    def _scatter_caches(self, dst, src, slots, plen):
+        """Scatters a prefilled cache pytree (tuple or NAPast) into slots."""
+        if isinstance(dst, NAPast):
+            return NAPast(
+                seq_past=tuple(
+                    self._scatter_kv(d, s, True, slots, plen)
+                    for d, s in zip(dst.seq_past, src.seq_past)
+                ),
+                dep_graph_past=tuple(
+                    self._scatter_kv(d, s, False, slots, plen)
+                    for d, s in zip(dst.dep_graph_past, src.dep_graph_past)
+                ),
+            )
+        return tuple(
+            self._scatter_kv(d, s, True, slots, plen) for d, s in zip(dst, src)
+        )
+
     def _admit(self, state, big1, caches1, plen, budgets, keys1, slots, first_event_real):
         """Scatters prefilled rows into the slot state. ``slots`` may carry
         out-of-range indices for inert padded group rows (dropped).
@@ -854,54 +1672,7 @@ class GenerationEngine:
             return jax.tree_util.tree_map(f, dst, src)
 
         big = scatter(state.big, big1)
-
-        def scatter_kv(dst: KVCache, src: KVCache, vector_len: bool) -> KVCache:
-            if dst.key_scale is not None:
-                # Quantize-on-admission: prefill ran (exactly) on float
-                # caches; the admitted rows land in the slot cache as
-                # int8/fp8 planes + per-head-per-row scales (ops/kv_quant).
-                from ..ops.kv_quant import quantize_kv
-
-                k_q, k_s = quantize_kv(src.key, dst.key.dtype)
-                v_q, v_s = quantize_kv(src.value, dst.value.dtype)
-                key = dst.key.at[slots].set(k_q, mode="drop")
-                value = dst.value.at[slots].set(v_q, mode="drop")
-                key_scale = dst.key_scale.at[slots].set(k_s, mode="drop")
-                value_scale = dst.value_scale.at[slots].set(v_s, mode="drop")
-            else:
-                key = dst.key.at[slots].set(src.key.astype(dst.key.dtype), mode="drop")
-                value = dst.value.at[slots].set(
-                    src.value.astype(dst.value.dtype), mode="drop"
-                )
-                key_scale = value_scale = None
-            return KVCache(
-                key=key,
-                value=value,
-                mask=dst.mask.at[slots].set(src.mask, mode="drop"),
-                length=(
-                    dst.length.at[slots].set(plen, mode="drop")
-                    if vector_len
-                    else src.length
-                ),
-                key_scale=key_scale,
-                value_scale=value_scale,
-            )
-
-        if self._is_na:
-            caches = NAPast(
-                seq_past=tuple(
-                    scatter_kv(d, s, True)
-                    for d, s in zip(state.caches.seq_past, caches1.seq_past)
-                ),
-                dep_graph_past=tuple(
-                    scatter_kv(d, s, False)
-                    for d, s in zip(state.caches.dep_graph_past, caches1.dep_graph_past)
-                ),
-            )
-        else:
-            caches = tuple(
-                scatter_kv(d, s, True) for d, s in zip(state.caches, caches1)
-            )
+        caches = self._scatter_caches(state.caches, caches1, slots, plen)
 
         n_gen1 = first_event_real.astype(jnp.int32)
         done1 = self._row_done(big1, cursor1, plen, n_gen1, budgets)
@@ -916,6 +1687,196 @@ class GenerationEngine:
             live=state.live.at[slots].set(True, mode="drop"),
             keys=state.keys.at[slots].set(keys1, mode="drop"),
         )
+
+    # ------------------------------------------------------- spec prefill
+    def _prefill_spec_jit(self, bucket_len: int, group: int):
+        """The spec-mode prefill program: the target's bucketed prefill with
+        the first generated event drawn on the per-event-index chain
+        (``fold_in(request_key, 0)``), plus the draft model's prefill of
+        its own cache rows — one dispatch admits a group into BOTH chains.
+        """
+        key = (bucket_len, group)
+        if key not in self._prefill_spec_jits:
+            fn = functools.partial(
+                self._prefill_spec_na if self._is_na else self._prefill_spec_ci,
+                bucket_len,
+            )
+            self._prefill_spec_jits[key] = jax.jit(fn, donate_argnums=(2, 3))
+        return self._prefill_spec_jits[key]
+
+    def _prefill_draft_forward(self, Lb, draft_params, pbig, big1, plen):
+        """The draft model's prompt forward: fills its per-slot cache rows
+        for positions ``0..plen-1`` (the committed-prefix invariant both
+        chains share). For NA, the dep-graph cache must additionally hold
+        the first sampled event's graph-element kvs — the state the
+        target's prefill walk leaves behind — so the draft replays the walk
+        teacher-forced on ``big1`` (the target-prefilled content), with each
+        level's view masked to the content the incremental walk would have
+        seen."""
+        n = pbig.batch_size
+        view = pbig.slice((slice(None), slice(0, Lb)))
+        if not self._is_na:
+            out = self.spec.model.apply(
+                draft_params,
+                view,
+                past=init_kv_caches(self.spec.config, n, max_len=self.max_len),
+                use_cache=True,
+                is_generation=True,
+            )
+            return out.past_key_values
+        out = self.spec.model.apply(
+            draft_params,
+            view,
+            past=NAPast(
+                seq_past=init_kv_caches(self.spec.config, n, max_len=self.max_len),
+                dep_graph_past=None,
+            ),
+            use_cache=True,
+            is_generation=True,
+            last_event_index=plen - 1,
+        )
+        past = NAPast(
+            seq_past=tuple(
+                kv.replace(length=plen) for kv in out.past_key_values.seq_past
+            ),
+            dep_graph_past=out.past_key_values.dep_graph_past,
+        )
+        n_levels = len(self._measurements_to_fill_list)
+        for level in range(1, n_levels):
+            masked = mask_batch_to_levels(big1, self._na_level_of_meas, level - 1)
+            walk_out = self.spec.model.apply(
+                draft_params,
+                _trim_to_event(masked, plen),
+                past=past,
+                use_cache=True,
+                is_generation=True,
+                dep_graph_el_generation_target=level,
+            )
+            past = walk_out.past_key_values
+        return past
+
+    def _admit_draft(self, sp: SpecState, caches1, plen, slots, history1=None) -> SpecState:
+        """Scatters draft prefill rows (and, for NA, the target's history
+        head of each prompt's last event) and zeroes the slots' per-tenant
+        spec counters (so a finished request's boundary carries exactly its
+        own acceptance accounting)."""
+        history = sp.history
+        if history1 is not None:
+            history = tuple(
+                h.at[slots].set(h1.astype(h.dtype), mode="drop")
+                for h, h1 in zip(sp.history, history1)
+            )
+        return sp.replace(
+            draft_caches=self._scatter_caches(sp.draft_caches, caches1, slots, plen),
+            proposed=sp.proposed.at[slots].set(0, mode="drop"),
+            accepted=sp.accepted.at[slots].set(0, mode="drop"),
+            history=history,
+        )
+
+    def _prefill_forward_ci_spec(self, Lb, params, pbig, plen, keys):
+        """`_prefill_forward_ci` on the spec PRNG chain: the first generated
+        event (index 0) draws under ``fold_in(request_key, 0)``; request
+        keys never advance (the chain is addressed per event index)."""
+        n = pbig.batch_size
+        view = pbig.slice((slice(None), slice(0, Lb)))
+        out = self.model.apply(
+            params,
+            view,
+            past=init_kv_caches(self.config, n, max_len=self.max_len),
+            use_cache=True,
+            is_generation=True,
+        )
+        base0 = fold_in_event(keys, jnp.zeros_like(plen))
+        preds_last = _slice_preds_at(out.preds, plen - 1)
+        em_last = take_event(pbig.event_mask, plen - 1)
+        draws = self._draw_rows(preds_last, base0)
+        sample = assemble_event_sample(preds_last, draws, em_last)
+        big1 = append_new_event(pbig, sample, self.config, plen)
+        big1 = update_last_event_data(big1, sample, self.config, plen + 1)
+        return big1, out.past_key_values, sample.event_mask
+
+    def _prefill_spec_ci(
+        self, Lb, params, draft_params, state, sp, pbig, plen, budgets, keys, slots
+    ):
+        big1, caches1, fer = self._prefill_forward_ci_spec(Lb, params, pbig, plen, keys)
+        state = self._admit(
+            state, big1, caches1, plen, budgets, keys, slots, first_event_real=fer
+        )
+        dcaches1 = self._prefill_draft_forward(Lb, draft_params, pbig, big1, plen)
+        return state, self._admit_draft(sp, dcaches1, plen, slots)
+
+    def _prefill_forward_na_spec(self, Lb, params, pbig, plen, keys):
+        """`_prefill_forward_na` on the spec chain: the first event's level
+        walk draws under ``fold_in(request_key, 0)`` sub-chained per level."""
+        n = pbig.batch_size
+        config = self.config
+        n_levels = len(self._measurements_to_fill_list)
+        cursor = plen
+        view = pbig.slice((slice(None), slice(0, Lb)))
+        base0 = fold_in_event(keys, jnp.zeros_like(plen))
+        out = self.model.apply(
+            params,
+            view,
+            past=NAPast(
+                seq_past=init_kv_caches(config, n, max_len=self.max_len),
+                dep_graph_past=None,
+            ),
+            use_cache=True,
+            is_generation=True,
+            last_event_index=plen - 1,
+            return_contextualized=True,
+        )
+        # The history-head seed: each row's last REAL prompt event's
+        # per-layer contextualized embedding (the verify window's position-0
+        # history once decode starts).
+        history1 = tuple(take_event(ctx, plen - 1) for ctx in out.contextualized)
+        past = out.past_key_values
+        past = NAPast(
+            seq_past=tuple(kv.replace(length=plen) for kv in past.seq_past),
+            dep_graph_past=past.dep_graph_past,
+        )
+        preds_last = _slice_preds_at(out.preds, cursor - 1)
+        em_last = take_event(pbig.event_mask, cursor - 1)
+        draws0 = self._draw_rows(preds_last, self._level_keys(base0, 0))
+        sample = assemble_event_sample(preds_last, draws0, em_last)
+        big = append_new_event(pbig, sample, config, cursor)
+        first_event_real = sample.event_mask
+
+        for level in range(1, n_levels):
+            view = _trim_to_event(big, cursor)
+            out = self.model.apply(
+                params,
+                view,
+                past=past,
+                use_cache=True,
+                is_generation=True,
+                dep_graph_el_generation_target=level,
+            )
+            past = out.past_key_values
+            preds_last = _slice_preds_at(out.preds, jnp.asarray(0))
+            em_last = take_event(big.event_mask, cursor)
+            draws_l = self._draw_rows(preds_last, self._level_keys(base0, level))
+            sample = assemble_event_sample(preds_last, draws_l, em_last)
+            big = update_last_event_data(
+                big,
+                sample,
+                config,
+                cursor + 1,
+                measurements_to_fill=self._level_fill_set(level),
+            )
+        return big, past, first_event_real, history1
+
+    def _prefill_spec_na(
+        self, Lb, params, draft_params, state, sp, pbig, plen, budgets, keys, slots
+    ):
+        big1, caches1, fer, history1 = self._prefill_forward_na_spec(
+            Lb, params, pbig, plen, keys
+        )
+        state = self._admit(
+            state, big1, caches1, plen, budgets, keys, slots, first_event_real=fer
+        )
+        dcaches1 = self._prefill_draft_forward(Lb, draft_params, pbig, big1, plen)
+        return state, self._admit_draft(sp, dcaches1, plen, slots, history1=history1)
 
     # -------------------------------------------------------------- extract
     def _extract_jit(self, group: int):
@@ -1003,9 +1964,24 @@ class GenerationEngine:
         n, g = len(group.requests), group.group_size
         stacked, plen, budgets, keys = self._group_arrays(group.requests, g)
         slots = jnp.asarray(group.slots + [self.n_slots] * (g - n), jnp.int32)
-        self._state = self._prefill_jit(group.bucket_len, g)(
-            self.params, self._state, stacked, plen, budgets, keys, slots
-        )
+        if self.spec is not None:
+            self._state, self._spec_state = self._prefill_spec_jit(
+                group.bucket_len, g
+            )(
+                self.params,
+                self.draft_params,
+                self._state,
+                self._spec_state,
+                stacked,
+                plen,
+                budgets,
+                keys,
+                slots,
+            )
+        else:
+            self._state = self._prefill_jit(group.bucket_len, g)(
+                self.params, self._state, stacked, plen, budgets, keys, slots
+            )
         for r, s in zip(group.requests, group.slots):
             self._table[s] = r
             self._slot_epoch[s] = self._dispatched_chunks
@@ -1025,6 +2001,13 @@ class GenerationEngine:
         engines, and a key derived from THIS engine's base key would break
         the target's determinism contract (the service/fleet assign keys at
         accept time, so theirs always do)."""
+        if self.spec is not None:
+            raise NotImplementedError(
+                "speculative engines do not serve behind a dedicated prefill "
+                "stream yet: the handoff would need the draft model's cache "
+                "rows (and the stream replica the draft weights); use the "
+                "budget-capped local prefill path (prefill_budget_events)"
+            )
         for r in requests:
             if r.key is None:
                 raise ValueError(
@@ -1114,6 +2097,19 @@ class GenerationEngine:
         for i, s in enumerate(finished):
             req = self._table[s]
             self._table[s] = None
+            spec_proposed = spec_accepted = 0
+            if self.spec is not None:
+                # Rows 4/5 of the spec boundary pack: this tenant's proposal
+                # and draft-acceptance totals (zeroed at admission). The
+                # scheduler keeps the engine-wide accepted-event budget
+                # accounting from the same numbers.
+                spec_proposed = int(boundary[4][s])
+                spec_accepted = int(boundary[5][s])
+                self.scheduler.note_spec_harvest(
+                    proposed=spec_proposed,
+                    accepted=spec_accepted,
+                    committed=int(boundary[1][s]) - int(boundary[2][s]),
+                )
             n_events = int(cursors[i])
             if rows is not None:
                 row = jax.tree_util.tree_map(
@@ -1140,6 +2136,8 @@ class GenerationEngine:
                     n_events=n_events,
                     n_generated=int(n_gens[i]),
                     completion_time=now,
+                    spec_proposed=spec_proposed,
+                    spec_accepted=spec_accepted,
                 )
             )
         return results
@@ -1194,10 +2192,26 @@ class GenerationEngine:
         device immediately after the decode dispatch and its host copy
         started with ``copy_to_host_async``; nothing blocks. The boundary
         queues on `_inflight` (strict FIFO: boundaries resolve in issue
-        order regardless of when their copies land)."""
-        self._state = self._decode_jit(self.params, self._state)
-        self._dispatched_chunks += 1
-        boundary = self._pack_boundary_jit(self._state)
+        order regardless of when their copies land).
+
+        Spec mode dispatches ``decode_chunk`` draft-chunk + verify rounds
+        per boundary (each round commits 1..K+1 events per active slot)
+        instead of ``decode_chunk`` single-event steps; the boundary pack
+        additionally carries the per-tenant proposed/accepted counters."""
+        if self.spec is not None:
+            for _ in range(self.decode_chunk):
+                self._state, self._spec_state, proposals = self._spec_draft_jit(
+                    self.draft_params, self._state, self._spec_state
+                )
+                self._state, self._spec_state = self._spec_verify_jit(
+                    self.params, self._state, self._spec_state, proposals
+                )
+            self._dispatched_chunks += 1
+            boundary = self._pack_boundary_jit(self._state, self._spec_state)
+        else:
+            self._state = self._decode_jit(self.params, self._state)
+            self._dispatched_chunks += 1
+            boundary = self._pack_boundary_jit(self._state)
         try:
             boundary.copy_to_host_async()
         except AttributeError:  # older jax Array impls: resolve() blocks
@@ -1276,12 +2290,19 @@ class GenerationEngine:
                 self._swap_reshard_memo = jax.jit(lambda p: p)
         return self._swap_reshard_memo
 
-    def load_shadow(self, new_params) -> None:
+    def load_shadow(self, new_params, new_draft_params=None) -> None:
         """Loads ``new_params`` into the shadow weight buffer beside the
         live weights (`hot_swap` must be enabled — `slots_report` has been
         accounting the second buffer since construction, so this allocation
         never overcommits HBM). Serving continues on the live buffer; call
-        `flip` at a drained chunk boundary to promote."""
+        `flip` at a drained chunk boundary to promote.
+
+        Spec engines stage ``new_draft_params`` alongside; `flip` then swaps
+        draft and target **atomically** — scoring one checkpoint's
+        proposals with the other's densities would silently change the
+        sampled distribution mid-promotion. ``None`` keeps the live draft
+        (a target-only promotion — correct, the draft only buys speed, but
+        expect the acceptance rate to sag until the draft catches up)."""
         if not self.hot_swap:
             raise RuntimeError(
                 "hot_swap is disabled for this engine; construct with "
@@ -1294,6 +2315,36 @@ class GenerationEngine:
                 "shadow checkpoint's parameter tree does not match the live "
                 f"weights: {new} vs {live}"
             )
+        if new_draft_params is None:
+            # Target-only staging keeps the LIVE draft: drop any armed
+            # rollback draft from a previous promotion, or the next flip
+            # would silently swap a two-generations-old draft back in.
+            self._shadow_draft_params = None
+        else:
+            if self.spec is None:
+                raise ValueError(
+                    "new_draft_params on a non-speculative engine; construct "
+                    "with spec=SpecConfig(...) to serve a draft model"
+                )
+            d_live = jax.tree_util.tree_structure(self.draft_params)
+            d_new = jax.tree_util.tree_structure(new_draft_params)
+            if d_live != d_new:
+                raise ValueError(
+                    "shadow draft checkpoint's parameter tree does not match "
+                    f"the live draft: {d_new} vs {d_live}"
+                )
+            if self._swap_draft_reshard_memo is None:
+                self._swap_draft_reshard_memo = (
+                    jax.jit(
+                        lambda p: p,
+                        out_shardings=jax.tree_util.tree_map(
+                            lambda _: NamedSharding(self.mesh, P()), self.draft_params
+                        ),
+                    )
+                    if self.mesh is not None
+                    else jax.jit(lambda p: p)
+                )
+            self._shadow_draft_params = self._swap_draft_reshard_memo(new_draft_params)
         self._shadow_params = self._swap_reshard_jit()(new_params)
 
     @property
@@ -1318,11 +2369,20 @@ class GenerationEngine:
                 "(stop admitting, resolve every boundary) before flipping"
             )
         self.params, self._shadow_params = self._shadow_params, self.params
+        if self._shadow_draft_params is not None:
+            # Atomic with the target flip: both pointers move in this one
+            # host step between dispatches — no round ever scores one
+            # checkpoint's proposals with the other's densities.
+            self.draft_params, self._shadow_draft_params = (
+                self._shadow_draft_params,
+                self.draft_params,
+            )
         self.weights_version += 1
 
     def drop_shadow(self) -> None:
         """Releases the shadow buffer's arrays (the rollback checkpoint)."""
         self._shadow_params = None
+        self._shadow_draft_params = None
 
     def reset(self) -> None:
         """Clears all slot/queue state, keeping every compiled program.
@@ -1332,8 +2392,14 @@ class GenerationEngine:
         measured window (mirroring every other bench section's discipline).
         """
         self._state = self._init_state()
+        if self.spec is not None:
+            self._spec_state = self._init_spec_state()
         if self.mesh is not None:
             self._state = jax.device_put(self._state, self._state_shardings())
+            if self._spec_state is not None:
+                self._spec_state = jax.device_put(
+                    self._spec_state, self._tree_shardings(self._spec_state)
+                )
         self._table = [None] * self.n_slots
         self._slot_epoch = [0] * self.n_slots
         self._dispatched_chunks = 0
@@ -1400,12 +2466,33 @@ class GenerationEngine:
             params_bytes = sum(
                 x.nbytes for x in jax.tree_util.tree_leaves(self.params)
             )
+        # Speculative decoding: the draft model's params are a second
+        # resident weight tree (doubled again under hot_swap — promotion
+        # stages a shadow draft too) and every slot pins a draft KV-cache
+        # row at the same max_len. Omitting either would let capacity
+        # planning overcommit HBM exactly when spec mode is on.
+        draft_params_bytes = 0
+        draft_kv_bytes = 0
+        if self.spec is not None:
+            draft_params_bytes = sum(
+                x.nbytes for x in jax.tree_util.tree_leaves(self.draft_params)
+            )
+            dcfg = self.spec.config
+            draft_kv_bytes = kv_cache_bytes_per_slot(
+                dcfg.num_hidden_layers,
+                dcfg.num_attention_heads,
+                max_len,
+                dcfg.head_dim,
+                cache_dtype_name(dcfg.compute_dtype),
+                dcfg.compute_dtype,
+            )
         if self.hot_swap:
             # Double-buffered weights: the shadow buffer is reserved for the
             # whole hot-swap lifetime (not just while a checkpoint is staged),
             # so capacity planning never overcommits HBM during a swap window.
             params_bytes = 2 * params_bytes
-        budget = max(int(hbm_gb * 1e9) - params_bytes, 0)
+            draft_params_bytes = 2 * draft_params_bytes
+        budget = max(int(hbm_gb * 1e9) - params_bytes - draft_params_bytes, 0)
 
         per_dtype = {}
         for name in CACHE_DTYPES:
@@ -1419,7 +2506,7 @@ class GenerationEngine:
             )
             per_dtype[name] = {
                 "kv_bytes_per_slot": kv_bytes,
-                "max_slots": int(budget // (kv_bytes + row_bytes)),
+                "max_slots": int(budget // (kv_bytes + row_bytes + draft_kv_bytes)),
             }
         # Canonical name (not the raw constructor string — aliases like
         # "bfloat16"/"f32" are accepted and must index per_dtype).
@@ -1432,6 +2519,9 @@ class GenerationEngine:
             "hbm_budget_gb": hbm_gb,
             "hot_swap": self.hot_swap,
             "params_bytes": params_bytes,
+            "spec": self.spec is not None,
+            "draft_params_bytes": draft_params_bytes,
+            "draft_kv_bytes_per_slot": draft_kv_bytes,
             "row_bytes_per_slot": int(row_bytes),
             "per_dtype": per_dtype,
             "slots_per_chip_ratio_vs_bf16": round(ratio, 3),
@@ -1452,10 +2542,46 @@ class GenerationEngine:
                 "active_slot_steps": active,
                 "wasted_decode_frac": round(1.0 - active / max(total, 1), 4),
                 "sampling_impl": self.sampling_impl_resolved,
+                "greedy": self.greedy,
                 "slots_report": self.slots_report(),
             }
         )
+        if self.spec is not None:
+            rounds = int(np.asarray(self._spec_state.rounds))  # graftcheck: allow GC001 -- post-run accounting readback
+            report.update(
+                {
+                    "spec_k": self.spec.k,
+                    "spec_rounds": rounds,
+                    "spec_value_rtol": self.spec.value_rtol,
+                    "spec_value_atol": self.spec.value_atol,
+                    "spec_draft_hidden_size": self.spec.config.hidden_size,
+                    "spec_draft_num_layers": self.spec.config.num_hidden_layers,
+                }
+            )
         return report
+
+    def spec_signature(self):
+        """The spec-mode identity the service's placement-invariance
+        contract hangs on: two replicas produce bit-identical results for
+        the same request only if their draft/K/tolerance/greedy knobs agree
+        (sampled-mode committed values depend on the draft's proposals).
+        ``(greedy, None)`` for non-speculative engines."""
+        if self.spec is None:
+            return (self.greedy, None)
+        # Draft WEIGHTS are deliberately not part of the tuple (object
+        # identity is meaningless across independently loaded copies of one
+        # checkpoint); the service compares them with the fleet's
+        # weight-fingerprint check instead.
+        return (
+            self.greedy,
+            (
+                self.spec.k,
+                self.spec.value_rtol,
+                self.spec.value_atol,
+                self.spec.config.hidden_size,
+                self.spec.config.num_hidden_layers,
+            ),
+        )
 
     # -------------------------------------------------- AOT (graftcheck B)
     def aot_programs(
@@ -1490,6 +2616,47 @@ class GenerationEngine:
         budgets = jnp.ones((group,), jnp.int32)
         keys = jnp.zeros((group, 2), jnp.uint32)
         slots = jnp.arange(group, dtype=jnp.int32)
+        if self.spec is not None:
+            if include_prefill_stream:
+                raise NotImplementedError(
+                    "speculative engines do not serve behind a dedicated "
+                    "prefill stream yet (prefill_compute); there are no "
+                    "split-prefill programs to gate"
+                )
+            # Spec engines compile the draft-chunk + verify pair instead of
+            # the single-event decode program; the verify program's args are
+            # the draft chunk's abstract outputs (AOT lowering needs shapes
+            # only). The ISSUE-13 gates: the verify program must carry zero
+            # NEW collective kinds vs the baseline decode (engine_dp8) — an
+            # all-gather of the slot-sharded logits plane into the verify
+            # hot loop is exactly the regression the budget would catch.
+            dc_args = (self.draft_params, self._state, self._spec_state)
+            _, _, proposals = jax.eval_shape(self._spec_draft_jit, *dc_args)
+            return {
+                "draft_chunk": (self._spec_draft_jit, dc_args),
+                "verify": (
+                    self._spec_verify_jit,
+                    (self.params, self._state, self._spec_state, proposals),
+                ),
+                f"prefill_b{bucket_len}": (
+                    self._prefill_spec_jit(bucket_len, group),
+                    (
+                        self.params,
+                        self.draft_params,
+                        self._state,
+                        self._spec_state,
+                        pbig,
+                        plen,
+                        budgets,
+                        keys,
+                        slots,
+                    ),
+                ),
+                "boundary_pack": (
+                    self._pack_boundary_jit,
+                    (self._state, self._spec_state),
+                ),
+            }
         programs = {
             "decode": (self._decode_jit, (self.params, self._state)),
             f"prefill_b{bucket_len}": (
@@ -1531,26 +2698,45 @@ def _census_programs():
     from ..analysis.program_census import CensusProgram
 
     donate = {"decode": (1,), "prefill_b8": (1,), "boundary_pack": ()}
+    spec_donate = {
+        "draft_chunk": (1, 2),
+        "verify": (1, 2),
+        "prefill_b8": (2, 3),
+        "boundary_pack": (),
+    }
     budget_keys = {
         "engine:decode": "engine_dp8",
         "engine:prefill_b8": "engine_prefill_dp8",
         "engine_kvq:decode": "engine_kvq_dp8",
         "engine_kvq:prefill_b8": "engine_kvq_prefill_dp8",
         "engine_sampling:decode": "engine_sampling_1dev",
+        "engine_spec:draft_chunk": "engine_spec_draft_dp8",
+        "engine_spec:verify": "engine_spec_verify_dp8",
+        "engine_spec:prefill_b8": "engine_spec_prefill_dp8",
+        "engine_spec_na:draft_chunk": "engine_spec_na_draft_1dev",
+        "engine_spec_na:verify": "engine_spec_na_verify_1dev",
     }
     out = {}
     for prefix, programs in (
         ("engine", pc.canonical_engine_programs(8)),
         ("engine_kvq", pc.canonical_kvq_engine_programs(8)),
         ("engine_sampling", pc.canonical_sampling_engine_program()),
+        # The r13 speculative-decoding programs: the slot-sharded CI spec
+        # engine on dp8 (the verify program's budget pins "zero new
+        # collective kinds vs engine_dp8" — the fused-sampling mesh rule
+        # must keep holding inside the K-event verify forward) and the NA
+        # variant (whole dep-graph walk verified in one fused pass).
+        ("engine_spec", pc.canonical_spec_engine_programs(8)),
+        ("engine_spec_na", pc.canonical_spec_engine_na_programs()),
     ):
+        spec_prefix = prefix.startswith("engine_spec")
         for key, (fn, args) in programs.items():
             label = f"{prefix}:{key}"
             out[label] = CensusProgram(
                 label,
                 fn,
                 args,
-                donate_argnums=donate.get(key, ()),
+                donate_argnums=(spec_donate if spec_prefix else donate).get(key, ()),
                 budget_key=budget_keys.get(label),
             )
     return out

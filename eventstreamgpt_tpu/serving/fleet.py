@@ -137,6 +137,12 @@ class PrefillStream:
     def attach(self, replicas: Sequence[GenerationEngine]) -> None:
         if self._targets is not None:
             raise RuntimeError("prefill stream is already attached to a service")
+        if self.engine.spec is not None or any(e.spec is not None for e in replicas):
+            raise NotImplementedError(
+                "speculative engines do not serve behind a dedicated prefill "
+                "stream yet (the handoff would need draft cache rows); use the "
+                "budget-capped local prefill path"
+            )
         for i, e in enumerate(replicas):
             if e is self.engine:
                 raise ValueError(
@@ -416,7 +422,12 @@ class ServingFleet:
         )
 
     # ------------------------------------------------------------ hot swap
-    def promote(self, new_params, at_time: Optional[float] = None) -> None:
+    def promote(
+        self,
+        new_params,
+        at_time: Optional[float] = None,
+        new_draft_params=None,
+    ) -> None:
         """Fleet-wide zero-downtime checkpoint promotion.
 
         Loads ``new_params`` into every engine's shadow buffer (decode
@@ -432,9 +443,18 @@ class ServingFleet:
         replay is in flight), it arms and `run`'s loop drives it — the
         swap-under-traffic e2e. Zero accepted requests are dropped either
         way (`swap_report`).
+
+        ``new_draft_params`` promotes a speculative fleet's draft model in
+        the SAME flip as the target — each engine stages both shadows and
+        swaps both pointers atomically (required: scoring one checkpoint's
+        proposals against the other's densities would silently change the
+        sampled distribution mid-promotion). Spec fleets must pass it;
+        omitting it on a spec fleet is a loud error rather than a silent
+        half-promotion.
         """
         if self._promotion is not None:
             raise RuntimeError("a promotion is already in flight")
+        any_spec = False
         for sid, svc in self.services.items():
             for eng in self._service_engines(svc):
                 if not eng.hot_swap:
@@ -442,8 +462,17 @@ class ServingFleet:
                         f"service {sid!r} has an engine without hot_swap=True; "
                         "the fleet cannot promote without shadow buffers"
                     )
+                any_spec = any_spec or eng.spec is not None
+        if any_spec and new_draft_params is None:
+            raise ValueError(
+                "this fleet serves speculative engines: promote(new_params, "
+                "new_draft_params=...) so draft and target swap atomically"
+            )
+        if not any_spec and new_draft_params is not None:
+            raise ValueError("new_draft_params on a fleet with no speculative engines")
         self._promotion = {
             "params": new_params,
+            "draft_params": new_draft_params,
             "at_time": at_time,
             "loaded": False,
             "draining": None,
@@ -467,10 +496,16 @@ class ServingFleet:
             return
         if not p["loaded"]:
             # Phase 1: stage the checkpoint into every shadow buffer
-            # fleet-wide (the HBM was reserved at engine construction).
+            # fleet-wide (the HBM was reserved at engine construction);
+            # spec engines stage their shadow draft in the same pass.
             for svc in self.services.values():
                 for eng in self._service_engines(svc):
-                    eng.load_shadow(p["params"])
+                    eng.load_shadow(
+                        p["params"],
+                        new_draft_params=(
+                            p["draft_params"] if eng.spec is not None else None
+                        ),
+                    )
             p["loaded"] = True
         if p["draining"] is None:
             remaining = [

@@ -77,6 +77,11 @@ class EngineResult:
     n_events: int  # prompt + written events (the row's final cursor)
     n_generated: int  # REAL generated events (masked writes excluded)
     completion_time: float = 0.0
+    # Speculative decoding (engine spec mode): this request's draft
+    # proposals and how many of its committed events came from them.
+    # Zero on non-speculative engines.
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
 
 def pow2_ceil(n: int) -> int:
@@ -148,6 +153,14 @@ class Scheduler:
         self._rejected = 0
         self._max_depth = 0
         self._prefill_deferrals = 0
+        # Speculative-decoding accounting (engine spec mode): decode-side
+        # budgets bind in COMMITTED events — a spec round advances a slot by
+        # 1..K+1 of them — so the scheduler tracks commits and where they
+        # came from (draft-accepted vs target-corrected) rather than decode
+        # steps. Fed per finished request by the engine's harvest.
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_committed = 0
 
     def submit(self, request: Request) -> Request:
         if request.prompt_len > max(self.buckets):
@@ -264,9 +277,18 @@ class Scheduler:
                     self._padded_events += bucket_len
         return groups
 
+    def note_spec_harvest(self, *, proposed: int, accepted: int, committed: int) -> None:
+        """Accumulates one finished request's speculative-decoding totals
+        (the engine calls this at harvest — the counters ride the boundary
+        pack, so the accounting costs no extra transfers)."""
+        self._spec_proposed += int(proposed)
+        self._spec_accepted += int(accepted)
+        self._spec_committed += int(committed)
+
     def padding_report(self) -> dict:
         """Prefill padding waste traded for the bounded program count, plus
-        the admission-queue backpressure counters."""
+        the admission-queue backpressure counters and (spec mode) the
+        accepted-event budget accounting."""
         padded = max(self._padded_events, 1)
         return {
             "prompt_events": self._prompt_events,
@@ -277,4 +299,10 @@ class Scheduler:
             "max_queue_depth": self._max_depth,
             "rejected_total": self._rejected,
             "prefill_deferrals": self._prefill_deferrals,
+            "spec_proposed_events": self._spec_proposed,
+            "spec_accepted_events": self._spec_accepted,
+            "spec_committed_events": self._spec_committed,
+            "spec_acceptance_rate": round(
+                self._spec_accepted / max(self._spec_proposed, 1), 4
+            ),
         }

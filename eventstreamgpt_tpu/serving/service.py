@@ -142,6 +142,34 @@ class ServingService:
                 f"replicas must share max_len (attention-width parity; the "
                 f"determinism contract) — got {sorted(max_lens)}"
             )
+        # Spec-mode parity: sampled-mode committed values depend on the
+        # draft's proposals (exact in distribution, not bitwise), so a
+        # mixed spec/non-spec — or mixed-draft — replica set would break
+        # the service's placement-invariance determinism contract. Knobs
+        # compare by value; draft WEIGHTS by the fleet's identity-or-
+        # fingerprint check (independently loaded copies of one checkpoint
+        # must pass; two different checkpoints must not).
+        sigs = {e.spec_signature() for e in self.replicas}
+        if len(sigs) != 1:
+            raise ValueError(
+                "replicas must share the speculative-decoding configuration "
+                "(all spec with the same draft/K/tolerances/greedy, or none): "
+                "committed results are draft-dependent, so a mixed set would "
+                "make results depend on placement"
+            )
+        if self.replicas[0].spec is not None and len(self.replicas) > 1:
+            from .fleet import _params_mismatch
+
+            for i, e in enumerate(self.replicas[1:], start=1):
+                mismatch = _params_mismatch(
+                    self.replicas[0].spec.params, e.spec.params
+                )
+                if mismatch is not None:
+                    raise ValueError(
+                        f"replica {i}'s draft weights differ from replica 0's "
+                        f"({mismatch}) — committed results are draft-dependent, "
+                        "so mixed drafts would make results depend on placement"
+                    )
         for i, e in enumerate(self.replicas):
             if e.occupied or e.scheduler.pending or e.inflight_chunks:
                 raise ValueError(f"replica {i} is not idle")

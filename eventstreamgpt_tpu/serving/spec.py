@@ -1,0 +1,412 @@
+"""Speculative decoding for the event-stream grammar: the accept/commit rule.
+
+Decode is one event per full-model forward; the engine's spec mode
+(`serving/engine.py`, ``spec=SpecConfig(...)``) breaks that wall: a cheap
+**draft model** proposes K future events per slot, the full model scores all
+K in ONE batched forward over the vector-length KV-cache branch, and an
+accepted prefix commits with per-row cursor advances — no cache rewinds.
+This module holds the model-free pieces: the draft/target coupling rule, the
+per-event-index PRNG chain, and draft-construction helpers.
+
+**The PRNG chain.** Baseline decode advances each request's key by
+sequential ``split``s — key ``j`` is unknowable without decoding events
+``0..j-1``. Spec mode instead sub-chains **per event index**: event ``j``'s
+base key is ``fold_in(request_key, j)`` (``j`` counted from the first
+generated event), and every head inside the event derives from that base by
+the head-name keys `generation.sampling` already uses. Draft proposals,
+target verification draws, acceptance uniforms, and residual draws for
+event ``j`` all live in that sub-chain — so results are reproducible and
+independent of slot placement, chunking, and refill order, exactly like the
+baseline engine, but NOT bit-identical to its split-chain in sampled mode
+(greedy mode draws nothing, hence its bit-identity contract).
+
+**The accept rule** (`spec_accept_level`) walks an event's heads in a fixed
+order and composes two exact couplings:
+
+* **Discrete heads** (single-label classification with its is-observed bit
+  folded into one combined pmf; multi-label / is-observed Bernoulli
+  vectors component-wise): the standard speculative rejection-sampling
+  rule — accept draft value ``x ~ q`` with probability ``min(1, p(x)/q(x))``;
+  on rejection sample the **exact residual** ``(p - q)^+ / Z`` (tractable in
+  closed form for every discrete head; the Bernoulli residual is the
+  deterministic flip). Heads after the first rejection re-draw from the
+  target's own named-key chain. The committed discrete marginal is exactly
+  ``p`` at every acceptance rate.
+* **Continuous heads** (TTE, regression values): comonotone shared-key
+  coupling. Draft and target draw with the SAME named key, so a good draft's
+  value ``x_q`` lands close to the target's ``x_p``; the head accepts iff
+  ``|x_q - x_p| <= atol + rtol * |x_p|`` and commits ``x_q``, else it
+  commits ``x_p`` itself (an exact target sample — no residual needed).
+  Either branch commits a value within the tolerance of an exact target
+  sample path-wise, so the committed law is within ``rtol``/``atol`` of the
+  target's in Wasserstein-infinity — and ``rtol = atol = 0`` is exactly the
+  target law (at zero continuous acceptance). Tolerances are knobs; the
+  default is tight enough that binned distribution tests cannot see it and
+  loose enough that float noise between the draft's one-event forwards and
+  the target's K-event verify forward doesn't zero the acceptance rate.
+
+An event accepts iff every head accepts; the first not-fully-accepted event
+becomes the round's **correction event** (accepted head prefix keeps draft
+values, the rejecting head commits its residual/coupled draw, later heads
+commit target draws) — so every verify round commits at least one exact
+target event, and an adversarially bad draft degrades to baseline
+throughput, never to wrong samples.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..distributions import Bernoulli, Categorical
+from ..generation.sampling import (
+    GenerativeSequenceModelSamples,
+    _named_key,
+    assemble_event_sample,
+)
+from ..models.config import StructuredTransformerConfig
+
+Array = Any
+
+
+@dataclasses.dataclass
+class SpecConfig:
+    """The draft side of a speculative-decoding engine.
+
+    Args:
+        model: the draft model module (CI or NA — must match the target's
+            structured mode).
+        params: draft parameters. Replicated on serving meshes (the draft is
+            narrow by design; sharding it would add collectives to the
+            proposal loop).
+        config: the draft's `StructuredTransformerConfig`. Its *measurement
+            grammar* (idxmaps, vocab offsets/sizes, generative modes, TTE
+            head family, dep-graph levels) must equal the target's — the
+            accept rule compares per-head densities, so the heads must mean
+            the same thing; width/depth are free (that's the point).
+        k: proposed events per round. A round commits between 1 and ``k + 1``
+            events (the bonus event rides the verify forward's last
+            position).
+        value_rtol / value_atol: the continuous-head acceptance tolerance
+            (see module docstring). Zero both for the exact-law-but-
+            zero-continuous-acceptance mode.
+    """
+
+    model: Any
+    params: Any
+    config: StructuredTransformerConfig
+    k: int = 4
+    value_rtol: float = 1e-3
+    value_atol: float = 1e-6
+
+    def validate_against(self, target: StructuredTransformerConfig) -> None:
+        """The measurement-grammar equality the accept rule relies on."""
+        pairs = [
+            ("structured_event_processing_mode", None),
+            ("measurements_idxmap", None),
+            ("vocab_offsets_by_measurement", None),
+            ("vocab_sizes_by_measurement", None),
+            ("measurements_per_generative_mode", None),
+            ("TTE_generation_layer_type", None),
+            ("measurements_per_dep_graph_level", None),
+        ]
+        for attr, _ in pairs:
+            a = getattr(self.config, attr, None)
+            b = getattr(target, attr, None)
+            if a != b:
+                raise ValueError(
+                    f"draft config disagrees with the target on `{attr}`: the "
+                    "accept rule compares per-head densities, so the draft must "
+                    f"share the target's measurement grammar ({a!r} != {b!r})"
+                )
+        if self.k < 1:
+            raise ValueError(f"SpecConfig.k must be >= 1, got {self.k}")
+
+
+def truncated_draft(
+    config: StructuredTransformerConfig, params, num_layers: int
+) -> tuple[StructuredTransformerConfig, Any]:
+    """A free draft model: the target's first ``num_layers`` layers.
+
+    Returns ``(draft_config, draft_params)`` — the target config with depth
+    truncated and a parameter tree keeping layers ``h0..h{num_layers-1}``
+    plus every non-layer parameter (embeddings, output heads) shared with
+    the target. No training needed: the truncated stack reuses the target's
+    own representations, which is the cheapest draft with a useful
+    acceptance rate (the width ladder's narrow configs are the trained
+    alternative). Requires the unrolled parameter layout; migrate scanned
+    checkpoints through `models.transformer.unstack_layer_params` first.
+    """
+    L = config.num_hidden_layers
+    if not (1 <= num_layers < L):
+        raise ValueError(f"num_layers must be in [1, {L}), got {num_layers}")
+    draft_config = copy.deepcopy(config)
+    draft_config.num_hidden_layers = num_layers
+    draft_config.seq_attention_layers = list(config.seq_attention_layers[:num_layers])
+    if getattr(config, "dep_graph_attention_layers", None) is not None:
+        draft_config.dep_graph_attention_layers = list(
+            config.dep_graph_attention_layers[:num_layers]
+        )
+
+    def walk(node):
+        from collections.abc import Mapping
+
+        if not isinstance(node, Mapping):
+            return node
+        if "h_scan" in node:
+            raise ValueError(
+                "truncated_draft needs the unrolled parameter layout; run "
+                "models.transformer.unstack_layer_params on the checkpoint first"
+            )
+        if all(f"h{i}" in node for i in range(L)):
+            out = {
+                k: walk(v)
+                for k, v in node.items()
+                if not (k.startswith("h") and k[1:].isdigit() and int(k[1:]) >= num_layers)
+            }
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return draft_config, walk(params)
+
+
+def fold_in_event(keys: Array, gen_index: Array) -> Array:
+    """Per-row event-index base keys: ``fold_in(request_key, j)``.
+
+    ``keys`` is the engine's raw ``(S, 2)`` uint32 per-slot request keys (in
+    spec mode they never advance — the chain is addressed, not walked);
+    ``gen_index`` is each row's generation index (``event_position -
+    prompt_len``), traced. THE spec-mode key derivation: draft, verify,
+    prefill first-event, and correction-walk draws all come through here.
+    """
+    return jax.vmap(lambda k, j: jax.random.fold_in(k, j))(keys, gen_index)
+
+
+def _nan_eq(a: Array, b: Array) -> Array:
+    """Elementwise exact equality with NaN == NaN (greedy acceptance)."""
+    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+        return (a == b) | (jnp.isnan(a) & jnp.isnan(b))
+    return a == b
+
+
+def _value_close(x_q: Array, x_p: Array, rtol: float, atol: float) -> Array:
+    """The continuous-head acceptance predicate (NaN pairs count as close —
+    matched unobserved draws)."""
+    both_nan = jnp.isnan(x_q) & jnp.isnan(x_p)
+    return both_nan | (jnp.abs(x_q - x_p) <= atol + rtol * jnp.abs(x_p))
+
+
+def _combined_single_label_logpmf(is_obs_logits, cls_logits: Array) -> Array:
+    """log-pmf of the COMMITTED single-label value ``where(obs, c, 0)``:
+    ``P(v) = p_obs * softmax(cls)[v] + (1 - p_obs) * [v == 0]``. Folding the
+    is-observed bit into one finite pmf makes the rejection rule exact
+    without tracking the (unidentifiable) latent decomposition of v == 0."""
+    lsm = jax.nn.log_softmax(cls_logits)
+    if is_obs_logits is None:
+        return lsm
+    comb = jax.nn.log_sigmoid(is_obs_logits) + lsm
+    return comb.at[0].set(jnp.logaddexp(comb[0], jax.nn.log_sigmoid(-is_obs_logits)))
+
+
+def _residual_categorical(log_p: Array, log_q: Array, key: jax.Array) -> Array:
+    """An exact draw from the normalized residual ``(p - q)^+``.
+
+    Guarded for the measure-zero float edge where the residual underflows to
+    all-zeros (p == q yet the accept test rejected): falls back to ``p``,
+    which that branch reaches with probability 0.
+    """
+    r = jnp.clip(jnp.exp(log_p) - jnp.exp(log_q), 0.0, None)
+    has_mass = r.sum() > 0.0
+    logits = jnp.where(
+        has_mass,
+        jnp.where(r > 0.0, jnp.log(jnp.maximum(r, 1e-45)), -1e30),
+        log_p,
+    )
+    return jax.random.categorical(key, logits)
+
+
+def spec_accept_level(
+    tgt_preds,
+    dft_preds,
+    dft_draws: dict,
+    tgt_draws: dict,
+    key: jax.Array,
+    event_mask: Array,
+    *,
+    greedy: bool,
+    rtol: float,
+    atol: float,
+) -> tuple[Array, GenerativeSequenceModelSamples]:
+    """One chain segment of the per-head accept walk, per row (vmap me).
+
+    A segment is a whole event for CI models, or one dep-graph level for NA
+    (the second speculation axis: the level walk is itself a chain). Heads
+    run in a fixed order — classification heads in prediction order, then
+    regression heads, then TTE — and the chain state threads through:
+    accepted-prefix heads keep the draft's values, the first rejected head
+    commits its residual (discrete) or coupled target draw (continuous),
+    and every later head re-draws from the target's named-key chain.
+
+    Args:
+        tgt_preds / dft_preds: the target's and draft's predictions for this
+            segment, sliced to the row (no batch dim).
+        dft_draws / tgt_draws: raw named-head draws
+            (`generation.sampling.sample_head_draws`) from the SAME
+            event-index base key — the coupling.
+        key: the event-index base key (acceptance uniforms and residual
+            draws derive under ``spec_acc:``/``spec_res:`` names, disjoint
+            from every sampling name).
+        event_mask: the (scalar) mask the committed event carries.
+        greedy: bitwise-equality acceptance against the target's greedy
+            draws (no randomness anywhere).
+
+    Returns:
+        ``(accepted, corrected)``: whether every head accepted, and the
+        event sample to commit when this segment is the chain's first
+        not-fully-accepted one.
+    """
+    tgt_sample = assemble_event_sample(tgt_preds, tgt_draws, event_mask)
+    accepted = jnp.asarray(True)
+    prior_rej = jnp.asarray(False)
+
+    def chain(accept_h, draft_val, residual_val, target_val):
+        nonlocal accepted, prior_rej
+        corrected = jnp.where(
+            prior_rej, target_val, jnp.where(accept_h, draft_val, residual_val)
+        )
+        prior_rej = prior_rej | ~accept_h
+        accepted = accepted & accept_h
+        return corrected
+
+    corr_cls = None
+    if tgt_preds.classification is not None:
+        corr_cls = {}
+        for m, (t_obs, t_dist) in tgt_preds.classification.items():
+            d_obs, d_dist = dft_preds.classification[m]
+            x_t = tgt_sample.classification[m]
+            if isinstance(t_dist, Categorical):
+                # Single-label head: the committed value's combined pmf.
+                if d_obs is None:
+                    x_q = dft_draws[f"cls:{m}"]
+                else:
+                    x_q = jnp.where(dft_draws[f"cls_obs:{m}"] == 1, dft_draws[f"cls:{m}"], 0)
+                x_q = x_q.astype(x_t.dtype)
+                if greedy:
+                    acc = _nan_eq(x_q, x_t)
+                    corr = chain(acc, x_q, x_t, x_t)
+                else:
+                    lp = _combined_single_label_logpmf(
+                        None if t_obs is None else t_obs.logits, t_dist.logits
+                    )
+                    lq = _combined_single_label_logpmf(
+                        None if d_obs is None else d_obs.logits, d_dist.logits
+                    )
+                    acc_key = _named_key(key, f"spec_acc:{m}")  # graftcheck: allow GC003 -- _named_key IS fold_in (distinct name per purpose)
+                    res_key = _named_key(key, f"spec_res:{m}")  # graftcheck: allow GC003 -- _named_key IS fold_in (distinct name per purpose)
+                    log_u = jnp.log(jax.random.uniform(acc_key))
+                    acc = log_u <= jnp.minimum(0.0, lp[x_q] - lq[x_q])
+                    x_r = _residual_categorical(lp, lq, res_key)
+                    corr = chain(acc, x_q, x_r.astype(x_t.dtype), x_t)
+            else:
+                # Multi-label Bernoulli vector: component-wise sequential
+                # rule — draft prefix, deterministic-flip residual at the
+                # first rejected component, coupled target draws after.
+                x_q = dft_draws[f"cls:{m}"].astype(x_t.dtype)
+                if greedy:
+                    acc = _nan_eq(x_q, x_t).all()
+                    corr = chain(acc, x_q, x_t, x_t)
+                else:
+                    lp = t_dist.log_prob(x_q)
+                    lq = d_dist.log_prob(x_q)
+                    acc_key = _named_key(key, f"spec_acc:{m}")  # graftcheck: allow GC003 -- _named_key IS fold_in (distinct name per purpose)
+                    log_u = jnp.log(jax.random.uniform(acc_key, x_q.shape))
+                    rej = log_u > jnp.minimum(0.0, lp - lq)
+                    first = jnp.argmax(rej)
+                    idx = jnp.arange(x_q.shape[-1])
+                    flip = (t_dist.logits > d_dist.logits).astype(x_t.dtype)
+                    mixed = jnp.where(
+                        idx < first, x_q, jnp.where(idx == first, flip, x_t)
+                    )
+                    acc = ~rej.any()
+                    corr = chain(acc, x_q, mixed, x_t)
+            corr_cls[m] = corr
+
+    corr_reg = None
+    if tgt_preds.regression is not None:
+        corr_reg = {}
+        for m, (t_obs, t_dist) in tgt_preds.regression.items():
+            d_obs, d_dist = dft_preds.regression[m]
+            raw_q = dft_draws[f"reg:{m}"]
+            raw_t = tgt_draws[f"reg:{m}"]
+            x_t = tgt_sample.regression[m]
+            if t_obs is None:
+                # Indexed/multivariate values: pure comonotone coupling. In
+                # greedy mode the "coupled target draw" is the greedy value
+                # itself; the tolerance still governs acceptance (zero both
+                # for strict bitwise acceptance).
+                if greedy:
+                    acc = _value_close(raw_q, x_t, rtol, atol).all()
+                else:
+                    acc = _value_close(raw_q, raw_t, rtol, atol).all()
+                corr = chain(acc, raw_q, x_t, x_t)
+            else:
+                # Univariate with an is-observed bit: the bit runs the exact
+                # Bernoulli rule; the value (reached only when the bit holds
+                # observed) runs the coupling.
+                o_q = dft_draws[f"reg_obs:{m}"]
+                val_q = jnp.where(o_q == 1, raw_q, jnp.nan)
+                if greedy:
+                    acc = _value_close(val_q, x_t, rtol, atol).all()
+                    corr = chain(acc, val_q, x_t, x_t)
+                else:
+                    lp_o = t_obs.log_prob(o_q)
+                    lq_o = d_obs.log_prob(o_q)
+                    acc_key = _named_key(key, f"spec_acc:{m}")  # graftcheck: allow GC003 -- _named_key IS fold_in (distinct name per purpose)
+                    log_u = jnp.log(jax.random.uniform(acc_key))
+                    rej_o = log_u > jnp.minimum(0.0, lp_o - lq_o)
+                    val_ok = (o_q != 1) | _value_close(raw_q, raw_t, rtol, atol).all()
+                    acc = ~rej_o & val_ok
+                    o_flip = (t_obs.logits > d_obs.logits).astype(o_q.dtype)
+                    residual = jnp.where(
+                        rej_o,
+                        jnp.where(o_flip == 1, raw_t, jnp.nan),
+                        raw_t,  # value-side rejection: bit accepted observed
+                    )
+                    corr = chain(acc, val_q, residual, x_t)
+            corr_reg[m] = corr
+
+    corr_tte = None
+    if tgt_preds.time_to_event is not None:
+        tte_q = jnp.nan_to_num(dft_draws["tte"], posinf=1000.0)
+        tte_t = tgt_sample.time_to_event
+        # Greedy and sampled modes share the coupling: in greedy the target
+        # draw IS the greedy value, so the same predicate applies.
+        acc = _value_close(tte_q, tte_t, rtol, atol)
+        corr_tte = chain(acc, tte_q, tte_t, tte_t)
+
+    corrected = GenerativeSequenceModelSamples(
+        event_mask=event_mask,
+        time_to_event=corr_tte,
+        classification=corr_cls,
+        regression=corr_reg,
+        regression_indices=tgt_sample.regression_indices,
+    )
+    return accepted, corrected
+
+
+def select_candidate(cands: list, index: Array):
+    """Per-row selection among ``len(cands)`` stacked candidate pytrees:
+    leaf ``i`` of the result is ``cands[index[row]]``'s leaf for each row.
+    Selection only (take_along_axis) — candidate values commit bit-exactly.
+    """
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, axis=0), *cands)
+
+    def pick(x):
+        idx = index.reshape((1,) + index.shape + (1,) * (x.ndim - 2))
+        return jnp.take_along_axis(x, idx, axis=0)[0]
+
+    return jax.tree_util.tree_map(pick, stacked)

@@ -109,6 +109,15 @@ class TestBenchTailCapture:
         "sampling_fused_ab_ms",
         "kvq_engine_events_per_sec_per_chip",
         "kvq_slots_per_chip_ratio",
+        # r13 speculative-decoding verdicts: draft-propose/one-pass-verify
+        # vs one-event-per-forward decode on identical offline requests
+        # (correctness pinned by greedy parity + the per-head chi-square in
+        # tests/test_spec.py; these are the measured speed/acceptance
+        # numbers), plus the Poisson-replay p95 on the engine arm's trace.
+        "spec_engine_events_per_sec_per_chip",
+        "spec_vs_engine_ratio",
+        "spec_acceptance_rate",
+        "spec_p95_latency_ms",
         "service_p95_latency_ms",
         # r12 serving-fleet verdicts: the 2-service router replay of the
         # service Poisson trace with a mid-trace hot checkpoint swap

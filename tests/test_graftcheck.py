@@ -807,6 +807,64 @@ class TestLoweredProgramGates:
         assert check_no_f64(text, "pretrain:na_pallas_dp8") == []
         assert check_no_host_transfers(text, "pretrain:na_pallas_dp8") == []
 
+    def test_spec_programs_are_f64_and_host_transfer_free(self):
+        """The r13 speculative-decoding programs: the draft-chunk and
+        verify programs are the new serving hot loop — a callback smuggled
+        into either would resurrect the per-event host sync, and the
+        accept/residual math must not leak f64 (log-pmf ratios are fp32 by
+        construction). Covers the dp8 CI set and the NA variant's
+        draft/verify pair."""
+        from eventstreamgpt_tpu.analysis.program_checks import (
+            canonical_spec_engine_na_programs,
+            canonical_spec_engine_programs,
+            check_no_f64,
+            check_no_host_transfers,
+        )
+
+        programs = canonical_spec_engine_programs(8)
+        assert set(programs) == {"draft_chunk", "verify", "prefill_b8", "boundary_pack"}
+        for label, (fn, args) in programs.items():
+            text = fn.lower(*args).as_text()
+            assert check_no_f64(text, f"engine_spec:{label}") == []
+            assert check_no_host_transfers(text, f"engine_spec:{label}") == []
+
+        na_programs = canonical_spec_engine_na_programs()
+        assert set(na_programs) == {"draft_chunk", "verify"}
+        for label, (fn, args) in na_programs.items():
+            text = fn.lower(*args).as_text()
+            assert check_no_f64(text, f"engine_spec_na:{label}") == []
+            assert check_no_host_transfers(text, f"engine_spec_na:{label}") == []
+
+    def test_spec_verify_budget_has_no_new_collective_kinds(self):
+        """The ISSUE-13 acceptance gate, against the COMMITTED budgets: the
+        K-event verify program must show zero collective kinds beyond the
+        baseline decode's (engine_dp8) scalar bookkeeping — in particular
+        the fused-sampling mesh rule (auto -> XLA tail on multi-device
+        meshes) must keep holding inside the verify forward, where a
+        regression would all-gather the slot-sharded logits plane and show
+        up as a KB-scale max_bytes."""
+        import json
+
+        from eventstreamgpt_tpu.analysis.program_checks import REPO_ROOT
+
+        layouts = json.loads((REPO_ROOT / "COLLECTIVES.json").read_text())["layouts"]
+        base = layouts["engine_dp8"]
+        verify = layouts["engine_spec_verify_dp8"]
+        kind_keys = [k for k in base if isinstance(base[k], dict) and "count" in base[k]]
+        base_kinds = {k for k in kind_keys if base[k]["count"] > 0}
+        verify_kinds = {k for k in kind_keys if verify.get(k, {}).get("count", 0) > 0}
+        assert verify_kinds <= base_kinds, (
+            f"verify introduced new collective kinds: {verify_kinds - base_kinds}"
+        )
+        # Scalar-bookkeeping class: no single collective grows past the
+        # baseline's largest op (a logits-plane gather would be KBs).
+        max_base = max(base[k]["max_bytes"] for k in kind_keys)
+        max_verify = max(verify[k]["max_bytes"] for k in kind_keys)
+        assert max_verify <= max_base, (verify, base)
+        # The NA variant and the single-device programs are zero-collective.
+        for key in ("engine_spec_na_draft_1dev", "engine_spec_na_verify_1dev"):
+            assert layouts[key]["total_count"] == 0, layouts[key]
+
     def test_scan_and_fsdp_steps_are_f64_and_host_transfer_free(self):
         """The r10 scale-up programs: the scan-over-layers pretrain step on
         the dp8 mesh (one scanned block body — the stacked-param relayout
@@ -1131,6 +1189,10 @@ class TestCommittedMemoryBudgets:
             "engine:decode",
             "engine_kvq:decode",
             "engine_sampling:decode",
+            "engine_spec:draft_chunk",
+            "engine_spec:verify",
+            "engine_spec_na:draft_chunk",
+            "engine_spec_na:verify",
             "service:decode",
             "service:decode_r1",
             "ladder:fsdp8@w2048",

@@ -1,0 +1,744 @@
+"""Tests for speculative decoding (serving/spec.py + the engine's spec mode).
+
+The load-bearing contracts (ISSUE 13 / docs/serving.md "Speculative
+decoding"):
+
+* **Greedy parity**: with zero value tolerance, spec-mode greedy decoding
+  reproduces the greedy non-speculative engine — event structure, masks,
+  and every integer/categorical value bit-identical; float values within
+  the last-ulp fusion-reassociation envelope the NA engine parity contract
+  already documents (the verify program and the decode program are
+  different XLA programs computing identical math).
+* **Distribution correctness**: sampled spec mode draws from the SAME
+  distribution as the baseline engine — pinned per measurement head by
+  two-sample chi-square tests over many seeds, at several draft qualities,
+  including an adversarially bad draft whose acceptance collapses to ~0
+  but whose samples must stay correct (rejection commits exact target
+  draws; a bad draft costs throughput, never correctness).
+* **Determinism**: spec results are bitwise invariant to decode-chunk
+  size, admission order, and slot placement (the per-event-index PRNG
+  chain is addressed, not walked).
+* **Acceptance**: a perfect draft (the target itself) accepts ~everything;
+  the committed-event accounting (per-request and scheduler-level) adds up.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.models.ci_model import CIPPTForGenerativeSequenceModeling
+from eventstreamgpt_tpu.models.na_model import NAPPTForGenerativeSequenceModeling
+from eventstreamgpt_tpu.serving import (
+    GenerationEngine,
+    Request,
+    Scheduler,
+    ServingService,
+    SpecConfig,
+    make_buckets,
+    truncated_draft,
+)
+
+from .test_generation import ci_config, make_prompt, na_config
+
+MAX_LEN = 8
+
+# chi-square critical values at alpha = 0.001 (very generous: these are
+# exactness pins, not power tests — a systematically wrong sampler blows
+# far past them, while seed noise at these sample sizes stays far under).
+CHI2_999 = {
+    1: 10.83, 2: 13.82, 3: 16.27, 4: 18.47, 5: 20.52,
+    6: 22.46, 7: 24.32, 8: 26.12, 9: 27.88, 10: 29.59,
+}
+
+
+def chi2_two_sample(a_counts, b_counts):
+    """Two-sample chi-square homogeneity statistic and its df."""
+    a = np.asarray(a_counts, float)
+    b = np.asarray(b_counts, float)
+    keep = (a + b) > 0
+    a, b = a[keep], b[keep]
+    na, nb = a.sum(), b.sum()
+    pooled = (a + b) / (na + nb)
+    ea, eb = na * pooled, nb * pooled
+    stat = ((a - ea) ** 2 / np.maximum(ea, 1e-9)).sum() + (
+        (b - eb) ** 2 / np.maximum(eb, 1e-9)
+    ).sum()
+    return float(stat), int(keep.sum() - 1)
+
+
+def assert_same_distribution(a_counts, b_counts, label):
+    stat, df = chi2_two_sample(a_counts, b_counts)
+    df = max(min(df, 10), 1)
+    assert stat < CHI2_999[df], f"{label}: chi2={stat:.1f} df={df} (counts {a_counts} vs {b_counts})"
+
+
+def build(kind: str):
+    config = ci_config() if kind == "ci" else na_config()
+    prompt = make_prompt(B=4, L=4)
+    cls = (
+        CIPPTForGenerativeSequenceModeling
+        if kind == "ci"
+        else NAPPTForGenerativeSequenceModeling
+    )
+    model = cls(config)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    return config, model, params, prompt, cls
+
+
+def engine_for(model, params, config, template, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("min_bucket", 2)
+    return GenerationEngine(model, params, config, template=template, **kw)
+
+
+def mixed_requests(prompt, n=4, key_seed=42):
+    reqs = []
+    for i in range(n):
+        Lp = 3 if i % 2 == 0 else 4
+        row = prompt.slice((slice(i % prompt.batch_size, i % prompt.batch_size + 1), slice(0, Lp)))
+        reqs.append(
+            Request(
+                prompt=row,
+                max_new_events=MAX_LEN - Lp,
+                key=jax.random.fold_in(jax.random.PRNGKey(key_seed), i),
+                request_id=i,
+            )
+        )
+    return reqs
+
+
+def assert_results_match(base, spec, rtol, atol, label=""):
+    by_id = {r.request_id: r for r in spec}
+    for b in base:
+        s = by_id[b.request_id]
+        assert b.n_events == s.n_events, (label, b.request_id, b.n_events, s.n_events)
+        assert b.n_generated == s.n_generated, (label, b.request_id)
+        for f in (
+            "event_mask",
+            "dynamic_indices",
+            "dynamic_measurement_indices",
+            "dynamic_values_mask",
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(b.batch, f)),
+                np.asarray(getattr(s.batch, f)),
+                err_msg=f"{label} req {b.request_id} {f}",
+            )
+        for f in ("time_delta", "dynamic_values"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(b.batch, f)),
+                np.asarray(getattr(s.batch, f)),
+                rtol=rtol,
+                atol=atol,
+                err_msg=f"{label} req {b.request_id} {f}",
+            )
+
+
+def collect_head_samples(results):
+    """Pools per-head samples over every generated event of every result."""
+    out = {"event_type": [], "multi_lab": [], "lab_vals_idx": [], "tte": [], "values": []}
+    for r in results:
+        em = np.asarray(r.batch.event_mask)[0]
+        meas = np.asarray(r.batch.dynamic_measurement_indices)[0]
+        idx = np.asarray(r.batch.dynamic_indices)[0]
+        vals = np.asarray(r.batch.dynamic_values)[0]
+        vmask = np.asarray(r.batch.dynamic_values_mask)[0]
+        td = np.asarray(r.batch.time_delta)[0]
+        for j in range(r.prompt_len, r.n_events):
+            if not em[j]:
+                continue
+            out["event_type"].extend(idx[j][meas[j] == 1].tolist())
+            out["multi_lab"].extend(idx[j][meas[j] == 2].tolist())
+            out["lab_vals_idx"].extend(idx[j][meas[j] == 3].tolist())
+            out["values"].extend(vals[j][(meas[j] == 3) & vmask[j]].tolist())
+            if j - 1 >= 0 and j < r.n_events:
+                out["tte"].append(td[j - 1])
+    return out
+
+
+# --------------------------------------------------------------- fast units
+class TestSpecUnits:
+    def test_combined_single_label_logpmf(self):
+        from eventstreamgpt_tpu.serving.spec import _combined_single_label_logpmf
+
+        cls_logits = jnp.asarray([0.3, -0.5, 1.2])
+        obs_logit = jnp.asarray(0.7)
+        lp = np.asarray(_combined_single_label_logpmf(obs_logit, cls_logits))
+        p_obs = 1 / (1 + np.exp(-0.7))
+        sm = np.exp(cls_logits - np.log(np.exp(cls_logits).sum()))
+        expect = p_obs * np.asarray(sm)
+        expect[0] += 1 - p_obs
+        np.testing.assert_allclose(np.exp(lp), expect, rtol=1e-5)
+        assert abs(np.exp(lp).sum() - 1.0) < 1e-5
+        # no observation head: plain softmax
+        lp2 = np.asarray(_combined_single_label_logpmf(None, cls_logits))
+        np.testing.assert_allclose(np.exp(lp2), np.asarray(sm), rtol=1e-5)
+
+    def test_residual_categorical_is_exact(self):
+        from eventstreamgpt_tpu.serving.spec import _residual_categorical
+
+        p = np.asarray([0.5, 0.3, 0.2])
+        q = np.asarray([0.2, 0.3, 0.5])
+        draws = [
+            int(
+                _residual_categorical(
+                    jnp.log(p), jnp.log(q), jax.random.PRNGKey(seed)
+                )
+            )
+            for seed in range(2000)
+        ]
+        counts = np.bincount(draws, minlength=3)
+        # residual = (p - q)^+ / Z = [1.0, 0, 0]
+        assert counts[0] == 2000 and counts[1] == 0 and counts[2] == 0
+        # degenerate residual (p == q) falls back to p, never NaNs
+        d = _residual_categorical(jnp.log(p), jnp.log(p), jax.random.PRNGKey(0))
+        assert 0 <= int(d) <= 2
+
+    def test_value_close(self):
+        from eventstreamgpt_tpu.serving.spec import _value_close
+
+        assert bool(_value_close(jnp.asarray(1.0), jnp.asarray(1.0005), 1e-3, 0.0))
+        assert not bool(_value_close(jnp.asarray(1.0), jnp.asarray(1.1), 1e-3, 0.0))
+        assert bool(_value_close(jnp.asarray(np.nan), jnp.asarray(np.nan), 0.0, 0.0))
+        assert not bool(_value_close(jnp.asarray(np.nan), jnp.asarray(1.0), 1.0, 1.0))
+
+    def test_scheduler_spec_accounting(self):
+        s = Scheduler(4, make_buckets(2, 7))
+        s.note_spec_harvest(proposed=12, accepted=9, committed=10)
+        s.note_spec_harvest(proposed=8, accepted=2, committed=4)
+        rep = s.padding_report()
+        assert rep["spec_proposed_events"] == 20
+        assert rep["spec_accepted_events"] == 11
+        assert rep["spec_committed_events"] == 14
+        assert rep["spec_acceptance_rate"] == round(11 / 20, 4)
+
+    def test_truncated_draft_structure(self):
+        config, model, params, prompt, _ = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        assert dcfg.num_hidden_layers == 1
+        assert len(dcfg.seq_attention_layers) == 1
+        enc = dparams["params"]["encoder"]
+        assert "h0" in enc and "h1" not in enc
+        # non-layer param LEAVES shared by identity (pure tree surgery)
+        a = jax.tree_util.tree_leaves(dparams["params"]["output_layer"])
+        b = jax.tree_util.tree_leaves(params["params"]["output_layer"])
+        assert all(x is y for x, y in zip(a, b))
+        with pytest.raises(ValueError, match="num_layers"):
+            truncated_draft(config, params, 2)
+
+    def test_spec_config_grammar_validation(self):
+        config, model, params, prompt, _ = build("ci")
+        import copy
+
+        bad = copy.deepcopy(config)
+        bad.measurements_idxmap = {"event_type": 1}
+        with pytest.raises(ValueError, match="measurement grammar"):
+            SpecConfig(model=model, params=params, config=bad).validate_against(config)
+
+
+# ------------------------------------------------------------ parity (slow)
+@pytest.mark.slow
+@pytest.mark.spec
+class TestSpecGreedyParity:
+    """Greedy spec mode vs the greedy baseline engine.
+
+    With zero value tolerance, acceptance requires bitwise equality, so
+    every committed event is the target's own greedy draw — structure and
+    integers bit-identical, floats within the documented last-ulp fusion
+    envelope. With the default tolerance and a perfect draft, acceptance is
+    high and committed values sit within the tolerance of the baseline's.
+    """
+
+    @pytest.mark.parametrize("kind", ["ci", "na"])
+    def test_strict_greedy_matches_baseline(self, kind):
+        config, model, params, prompt, cls = build(kind)
+        dcfg, dparams = truncated_draft(config, params, 1)
+        dmodel = cls(dcfg)
+        base = engine_for(model, params, config, prompt, greedy=True).run(
+            mixed_requests(prompt)
+        )
+        spec = engine_for(
+            model,
+            params,
+            config,
+            prompt,
+            greedy=True,
+            spec=SpecConfig(
+                model=dmodel, params=dparams, config=dcfg, k=3,
+                value_rtol=0.0, value_atol=0.0,
+            ),
+        ).run(mixed_requests(prompt))
+        assert_results_match(base, spec, rtol=2e-5, atol=1e-6, label=f"{kind} strict")
+
+    @pytest.mark.parametrize("kind", ["ci", "na"])
+    def test_tolerant_greedy_perfect_draft_accepts(self, kind):
+        config, model, params, prompt, _ = build(kind)
+        eng = engine_for(
+            model,
+            params,
+            config,
+            prompt,
+            greedy=True,
+            spec=SpecConfig(model=model, params=params, config=config, k=3),
+        )
+        base = engine_for(model, params, config, prompt, greedy=True).run(
+            mixed_requests(prompt)
+        )
+        spec = eng.run(mixed_requests(prompt))
+        # committed values within the tolerance envelope of the baseline's
+        assert_results_match(base, spec, rtol=5e-3, atol=1e-4, label=f"{kind} tol")
+        assert eng.stats()["spec_acceptance_rate"] > 0.9
+
+
+@pytest.mark.slow
+@pytest.mark.spec
+class TestSpecDeterminism:
+    @pytest.mark.parametrize("kind", ["ci", "na"])
+    def test_chunk_and_refill_invariance_bitwise(self, kind):
+        """Same spec geometry ⇒ results bitwise independent of admission
+        order, slot count, and rounds-per-dispatch (the event-index PRNG
+        chain is addressed, not walked)."""
+        config, model, params, prompt, cls = build(kind)
+        dcfg, dparams = truncated_draft(config, params, 1)
+        dmodel = cls(dcfg)
+        sc = lambda: SpecConfig(model=dmodel, params=dparams, config=dcfg, k=2)  # noqa: E731
+        base = engine_for(model, params, config, prompt, spec=sc()).run(
+            mixed_requests(prompt)
+        )
+        redo = {
+            r.request_id: r
+            for r in engine_for(
+                model, params, config, prompt, decode_chunk=1, spec=sc()
+            ).run(list(reversed(mixed_requests(prompt))))
+        }
+        for r in base:
+            o = redo[r.request_id]
+            assert r.n_events == o.n_events
+            for f in ("event_mask", "time_delta", "dynamic_indices", "dynamic_values"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(r.batch, f)), np.asarray(getattr(o.batch, f))
+                )
+
+    def test_per_row_budgets_and_dead_rows(self):
+        """Budgets bind per row in COMMITTED events; a dead (masked) prompt
+        row stops after one probe event exactly like the baseline."""
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        dmodel = cls(dcfg)
+        sc = SpecConfig(model=dmodel, params=dparams, config=dcfg, k=3)
+        eng = engine_for(model, params, config, prompt, spec=sc)
+        reqs = [
+            Request(
+                prompt=prompt.slice((slice(i, i + 1), slice(0, 4))),
+                max_new_events=b,
+                key=jax.random.fold_in(jax.random.PRNGKey(3), i),
+                request_id=i,
+            )
+            for i, b in enumerate((1, 2, 4))
+        ]
+        results = eng.run(reqs)
+        assert [r.n_events - r.prompt_len for r in results] == [1, 2, 4]
+
+        padded = prompt.replace(event_mask=prompt.event_mask.at[0, 2:].set(False))
+        eng2 = engine_for(model, params, config, prompt, spec=sc)
+        res = eng2.run(
+            [
+                Request(
+                    prompt=padded.slice((slice(0, 1), slice(0, 4))),
+                    max_new_events=4,
+                    key=jax.random.PRNGKey(5),
+                    request_id=0,
+                )
+            ]
+        )[0]
+        assert res.n_generated == 0
+        assert res.n_events < MAX_LEN  # stopped before the full budget
+
+
+# ----------------------------------------------- distribution pin (slow)
+@pytest.mark.slow
+@pytest.mark.spec
+class TestSpecDistribution:
+    """The sampled-mode correctness pin: spec-mode samples vs the baseline
+    engine over many seeds, per measurement head, at several draft
+    qualities. The adversarial draft's acceptance must collapse to ~0 with
+    the distribution still intact — a bad draft degrades THROUGHPUT, never
+    samples."""
+
+    N_REQUESTS = 96
+    BUDGET = 3
+
+    def _requests(self, prompt, seed):
+        reqs = []
+        for i in range(self.N_REQUESTS):
+            row = prompt.slice((slice(i % 4, i % 4 + 1), slice(0, 4)))
+            reqs.append(
+                Request(
+                    prompt=row,
+                    max_new_events=self.BUDGET,
+                    key=jax.random.fold_in(jax.random.PRNGKey(seed), i),
+                    request_id=i,
+                )
+            )
+        return reqs
+
+    def _run(self, model, params, config, prompt, spec=None):
+        eng = engine_for(
+            model, params, config, prompt, n_slots=4, decode_chunk=2, spec=spec
+        )
+        res = eng.run(self._requests(prompt, seed=1000))
+        return collect_head_samples(res), eng.stats()
+
+    def test_ci_distribution_across_draft_qualities(self):
+        config, model, params, prompt, cls = build("ci")
+        ref, _ = self._run(model, params, config, prompt)
+
+        # Draft qualities: perfect (the target), truncated depth (mid), and
+        # adversarial (random init — different weights entirely).
+        dcfg_t, dparams_t = truncated_draft(config, params, 1)
+        bad_params = model.init(jax.random.PRNGKey(999), prompt)
+        qualities = {
+            "perfect": SpecConfig(model=model, params=params, config=config, k=3),
+            "truncated": SpecConfig(
+                model=cls(dcfg_t), params=dparams_t, config=dcfg_t, k=3
+            ),
+            "adversarial": SpecConfig(
+                model=model, params=bad_params, config=config, k=3
+            ),
+        }
+        et_bins = np.arange(1, 5)
+        ml_bins = np.arange(4, 9)
+        lv_bins = np.arange(8, 13)
+        tte_edges = np.quantile(np.asarray(ref["tte"]), [0.25, 0.5, 0.75])
+        val_edges = np.quantile(np.asarray(ref["values"]), [0.25, 0.5, 0.75]) if ref["values"] else None
+        rates = {}
+        for name, sc in qualities.items():
+            got, stats = self._run(model, params, config, prompt, spec=sc)
+            rates[name] = stats["spec_acceptance_rate"]
+            assert_same_distribution(
+                np.histogram(ref["event_type"], bins=et_bins)[0],
+                np.histogram(got["event_type"], bins=et_bins)[0],
+                f"{name}: event_type",
+            )
+            assert_same_distribution(
+                np.histogram(ref["multi_lab"], bins=ml_bins)[0],
+                np.histogram(got["multi_lab"], bins=ml_bins)[0],
+                f"{name}: multi_lab",
+            )
+            assert_same_distribution(
+                np.histogram(ref["lab_vals_idx"], bins=lv_bins)[0],
+                np.histogram(got["lab_vals_idx"], bins=lv_bins)[0],
+                f"{name}: lab_vals indices",
+            )
+            assert_same_distribution(
+                np.histogram(np.digitize(ref["tte"], tte_edges), bins=np.arange(5))[0],
+                np.histogram(np.digitize(got["tte"], tte_edges), bins=np.arange(5))[0],
+                f"{name}: tte (quartile bins)",
+            )
+            if val_edges is not None:
+                assert_same_distribution(
+                    np.histogram(np.digitize(ref["values"], val_edges), bins=np.arange(5))[0],
+                    np.histogram(np.digitize(got["values"], val_edges), bins=np.arange(5))[0],
+                    f"{name}: regression values (quartile bins)",
+                )
+        # Acceptance ordering: perfect >> adversarial; adversarial ~ 0.
+        assert rates["perfect"] > 0.9, rates
+        assert rates["adversarial"] < 0.2, rates
+        assert rates["perfect"] >= rates["truncated"] >= rates["adversarial"], rates
+
+    def test_na_distribution_and_adversarial_draft(self):
+        config, model, params, prompt, cls = build("na")
+        ref, _ = self._run(model, params, config, prompt)
+        bad_params = model.init(jax.random.PRNGKey(999), prompt)
+        for name, sc in {
+            "perfect": SpecConfig(model=model, params=params, config=config, k=2),
+            "adversarial": SpecConfig(model=model, params=bad_params, config=config, k=2),
+        }.items():
+            got, stats = self._run(model, params, config, prompt, spec=sc)
+            assert_same_distribution(
+                np.histogram(ref["event_type"], bins=np.arange(1, 5))[0],
+                np.histogram(got["event_type"], bins=np.arange(1, 5))[0],
+                f"na {name}: event_type",
+            )
+            tte_edges = np.quantile(np.asarray(ref["tte"]), [0.25, 0.5, 0.75])
+            assert_same_distribution(
+                np.histogram(np.digitize(ref["tte"], tte_edges), bins=np.arange(5))[0],
+                np.histogram(np.digitize(got["tte"], tte_edges), bins=np.arange(5))[0],
+                f"na {name}: tte",
+            )
+            if name == "perfect":
+                assert stats["spec_acceptance_rate"] > 0.9
+            else:
+                assert stats["spec_acceptance_rate"] < 0.3
+
+
+# -------------------------------------------------- accounting + capacity
+@pytest.mark.slow
+@pytest.mark.spec
+class TestSpecAccounting:
+    def test_per_request_and_scheduler_accounting(self):
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
+        eng = engine_for(model, params, config, prompt, spec=sc)
+        results = eng.run(mixed_requests(prompt))
+        stats = eng.stats()
+        assert stats["spec_k"] == 2
+        assert stats["spec_rounds"] > 0
+        # Scheduler totals == sum of per-request totals (same boundary pack).
+        assert stats["spec_proposed_events"] == sum(r.spec_proposed for r in results)
+        assert stats["spec_accepted_events"] == sum(r.spec_accepted for r in results)
+        assert stats["spec_committed_events"] == sum(
+            r.n_events - r.prompt_len for r in results
+        )
+        for r in results:
+            assert 0 <= r.spec_accepted <= r.n_events - r.prompt_len
+        assert 0.0 <= stats["spec_acceptance_rate"] <= 1.0
+
+    def test_slots_report_accounts_draft(self):
+        """Capacity planning must see the draft: params (doubled under
+        hot_swap) and the per-slot draft KV row both shrink max_slots."""
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
+        plain = engine_for(model, params, config, prompt)
+        spec = engine_for(model, params, config, prompt, spec=sc)
+        r_plain, r_spec = plain.slots_report(), spec.slots_report()
+        assert not r_plain["spec"] and r_spec["spec"]
+        assert r_plain["draft_params_bytes"] == 0
+        assert r_spec["draft_params_bytes"] > 0
+        assert r_spec["draft_kv_bytes_per_slot"] > 0
+        assert (
+            r_spec["per_dtype"]["fp32"]["max_slots"]
+            < r_plain["per_dtype"]["fp32"]["max_slots"]
+        )
+        swap = engine_for(model, params, config, prompt, spec=sc, hot_swap=True)
+        r_swap = swap.slots_report()
+        assert r_swap["draft_params_bytes"] == 2 * r_spec["draft_params_bytes"]
+        assert r_swap["params_bytes"] == 2 * r_spec["params_bytes"]
+
+
+@pytest.mark.slow
+@pytest.mark.spec
+class TestSpecValidation:
+    def test_incompatible_knobs_raise(self):
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
+        with pytest.raises(ValueError, match="top_k/top_p"):
+            engine_for(model, params, config, prompt, spec=sc, top_k=2)
+        from eventstreamgpt_tpu.generation.stopping_criteria import MaxLengthCriteria
+
+        with pytest.raises(ValueError, match="device_criteria"):
+            engine_for(
+                model, params, config, prompt, spec=sc,
+                device_criteria=(MaxLengthCriteria(6),),
+            )
+        with pytest.raises(ValueError, match="quantized"):
+            engine_for(model, params, config, prompt, spec=sc, kv_cache_dtype="int8")
+
+    def test_service_rejects_mixed_spec_replicas(self):
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
+        plain = engine_for(model, params, config, prompt)
+        spec = engine_for(model, params, config, prompt, spec=sc)
+        with pytest.raises(ValueError, match="speculative-decoding configuration"):
+            ServingService([plain, spec])
+
+    def test_prefill_stream_rejects_spec(self):
+        from eventstreamgpt_tpu.serving import PrefillStream
+
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
+        spec = engine_for(model, params, config, prompt, spec=sc)
+        pf = engine_for(model, params, config, prompt)
+        with pytest.raises(NotImplementedError, match="prefill stream"):
+            PrefillStream(pf).attach([spec])
+
+
+@pytest.mark.slow
+@pytest.mark.spec
+class TestSpecServiceAndSwap:
+    def test_spec_engine_behind_service_matches_sync_engine(self):
+        """A spec engine serves behind the service unchanged: the service's
+        accepted set reproduces a synchronous spec engine run with the
+        service's key derivation — the lanes/placement machinery adds no
+        bits."""
+        from eventstreamgpt_tpu.serving.engine import derive_request_key
+
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        sc = lambda: SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)  # noqa: E731
+        service_key = jax.random.PRNGKey(77)
+
+        svc = ServingService(
+            [engine_for(model, params, config, prompt, spec=sc())],
+            base_key=service_key,
+        )
+        reqs = [
+            Request(
+                prompt=prompt.slice((slice(i, i + 1), slice(0, 4))),
+                max_new_events=3,
+                request_id=i,
+            )
+            for i in range(4)
+        ]
+        for r in reqs:
+            assert svc.submit(r)
+        svc_results = {r.request_id: r for r in svc.run()}
+
+        ref_engine = engine_for(model, params, config, prompt, spec=sc())
+        ref = ref_engine.run(
+            [
+                Request(
+                    prompt=prompt.slice((slice(i, i + 1), slice(0, 4))),
+                    max_new_events=3,
+                    key=derive_request_key(service_key, i),
+                    request_id=i,
+                )
+                for i in range(4)
+            ]
+        )
+        for b in ref:
+            s = svc_results[b.request_id]
+            assert b.n_events == s.n_events
+            for f in ("event_mask", "time_delta", "dynamic_indices", "dynamic_values"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(b.batch, f)),
+                    np.asarray(getattr(s.batch, f)),
+                )
+
+    def test_hot_swap_swaps_draft_and_target_atomically(self):
+        """Promotion stages both shadows and flips both pointers in one
+        step; post-flip results equal a fresh spec engine built on the new
+        checkpoint pair."""
+        config, model, params, prompt, cls = build("ci")
+        new_params = model.init(jax.random.PRNGKey(123), prompt)
+        dcfg, dparams = truncated_draft(config, params, 1)
+        dcfg2, dparams2 = truncated_draft(config, new_params, 1)
+        dmodel = cls(dcfg)
+        sc = SpecConfig(model=dmodel, params=dparams, config=dcfg, k=2)
+        eng = engine_for(model, params, config, prompt, spec=sc, hot_swap=True)
+        eng.run(mixed_requests(prompt))
+        eng.load_shadow(new_params, new_draft_params=dparams2)
+        eng.flip()
+        assert eng.weights_version == 1
+        after = eng.run(mixed_requests(prompt, key_seed=91))
+
+        sc2 = SpecConfig(model=dmodel, params=dparams2, config=dcfg2, k=2)
+        fresh = engine_for(model, new_params, config, prompt, spec=sc2).run(
+            mixed_requests(prompt, key_seed=91)
+        )
+        assert_results_match(fresh, after, rtol=0, atol=0, label="post-flip")
+
+    def test_target_only_promotion_drops_stale_rollback_draft(self):
+        """After a draft+target flip, a later target-only load_shadow must
+        NOT leave the previous draft armed — flipping would silently swap a
+        two-generations-old draft back in."""
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        dcfg2, dparams2 = truncated_draft(config, model.init(jax.random.PRNGKey(1), prompt), 1)
+        sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
+        eng = engine_for(model, params, config, prompt, spec=sc, hot_swap=True)
+        eng.load_shadow(model.init(jax.random.PRNGKey(2), prompt), new_draft_params=dparams2)
+        eng.flip()
+        live_draft = eng.draft_params
+        eng.load_shadow(model.init(jax.random.PRNGKey(3), prompt))  # target-only
+        eng.flip()
+        assert eng.draft_params is live_draft  # draft pointer untouched
+
+    def test_service_accepts_independently_loaded_identical_drafts(self):
+        """Replicas built from separate-but-identical copies of one draft
+        checkpoint must pass the parity check (weights compare by
+        fingerprint, not object identity); different drafts must not."""
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        copy_dparams = jax.tree_util.tree_map(lambda x: jnp.array(x), dparams)
+        dmodel = cls(dcfg)
+        a = engine_for(model, params, config, prompt,
+                       spec=SpecConfig(model=dmodel, params=dparams, config=dcfg, k=2))
+        b = engine_for(model, params, config, prompt,
+                       spec=SpecConfig(model=dmodel, params=copy_dparams, config=dcfg, k=2))
+        ServingService([a, b])  # identical copies: accepted
+        other = truncated_draft(config, model.init(jax.random.PRNGKey(4), prompt), 1)[1]
+        c = engine_for(model, params, config, prompt,
+                       spec=SpecConfig(model=dmodel, params=other, config=dcfg, k=2))
+        with pytest.raises(ValueError, match="draft weights differ"):
+            ServingService(
+                [
+                    engine_for(model, params, config, prompt,
+                               spec=SpecConfig(model=dmodel, params=dparams, config=dcfg, k=2)),
+                    c,
+                ]
+            )
+
+    def test_spec_fleet_promotion_requires_draft(self):
+        from eventstreamgpt_tpu.serving import ServingFleet
+
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
+        svc = ServingService(
+            [engine_for(model, params, config, prompt, spec=sc, hot_swap=True)]
+        )
+        fleet = ServingFleet({"svc0": svc})
+        with pytest.raises(ValueError, match="atomically"):
+            fleet.promote(params)
+
+
+# ----------------------------------------- multi-event vector cache branch
+@pytest.mark.slow
+@pytest.mark.spec
+class TestVectorCacheMultiEvent:
+    def test_window_writes_bitwise_equal_sequential(self):
+        """The S>1 vector-length cache branch (the verify window's range
+        scatter) lands values bit-identical to S sequential one-event
+        writes, and the window forward's per-position outputs equal the
+        sequential decode forwards' (same cache widths ⇒ same reductions)."""
+        config, model, params, prompt, _ = build("ci")
+        eng = engine_for(model, params, config, prompt, greedy=True)
+        for r in mixed_requests(prompt, n=2):
+            eng.submit(r)
+        eng.plan_and_dispatch()
+        st0 = eng._state
+        st1 = eng._decode_step_ci(params, st0)
+        st2 = eng._decode_step_ci(params, st1)
+        view = eng._window_view(st2.big, st0.cursor - 1, 3)
+        out = model.apply(
+            params, view, past=st0.caches, use_cache=True, is_generation=True
+        )
+        # Window kv writes at the two sequentially-written positions.
+        for i, (kw, ks) in enumerate(zip(out.past_key_values, st2.caches)):
+            for f in ("key", "value"):
+                a = np.asarray(getattr(ks, f))
+                b = np.asarray(getattr(kw, f))
+                c0 = np.asarray(st0.cursor)
+                for row in range(a.shape[0]):
+                    lo, hi = int(c0[row]) - 1, int(c0[row]) + 1
+                    np.testing.assert_array_equal(
+                        a[row, :, lo:hi], b[row, :, lo:hi],
+                        err_msg=f"layer {i} {f} row {row}",
+                    )
+        # Per-position preds: window position 0 == the first decode
+        # forward's (computed pre-commit on identical state).
+        out_seq = model.apply(
+            params,
+            eng._window_view(st0.big, st0.cursor - 1, 1),
+            past=st0.caches,
+            use_cache=True,
+            is_generation=True,
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(lambda x: x[:, 0], out.preds)
+            ),
+            jax.tree_util.tree_leaves(
+                jax.tree_util.tree_map(lambda x: x[:, 0], out_seq.preds)
+            ),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
