@@ -1085,6 +1085,67 @@ def main():
         for sid in fleet.services
     }
 
+    # ---- degraded fleet (r15; docs/reliability.md "Serving failure
+    # domains"): the SAME Poisson trace through a fresh 2-service fleet
+    # with a ServingFaultPlan killing one replica at the trace midpoint
+    # (keyed on its chunk counter — half the healthy run's dispatched
+    # chunks, no wall clock). The health monitor evicts the dead service
+    # via the router and replays its in-flight sessions on the survivor
+    # from their bound keys (bit-identity pinned in
+    # tests/test_serving_faults.py); the tail keys are the measured cost
+    # of serving through the failure: degraded p95 vs the healthy fleet,
+    # and how many sessions the eviction replayed. Zero requests may drop.
+    from eventstreamgpt_tpu.reliability import (
+        ServingFault,
+        ServingFaultPlan,
+        serving_fault_plan,
+    )
+    from eventstreamgpt_tpu.serving import FleetHealthConfig
+
+    tunnel_probe("fleet_degraded", extras)
+    deg_fleet = ServingFleet(
+        {"svc0": fleet_service(), "svc1": fleet_service()},
+        base_key=jax.random.PRNGKey(11),
+        health=FleetHealthConfig(),
+    )
+    healthy_chunks = fleet.stats()["services"]["svc0"]["replicas"][0][
+        "dispatched_chunks"
+    ]
+    deg_trace = [
+        (
+            f"subject-{i}",
+            Request(
+                prompt=eng_prompt_rows[i][0],
+                max_new_events=eng_prompt_rows[i][2],
+                request_id=i,
+                arrival_time=float(arrivals[i]),
+            ),
+            "batch" if i % 10 >= 7 else "interactive",
+        )
+        for i in range(N_LAT)
+    ]
+    deg_plan = ServingFaultPlan(
+        [
+            ServingFault(
+                "death", service="svc0", chunk_index=max(1, healthy_chunks // 2)
+            )
+        ]
+    )
+    with serving_fault_plan(deg_plan):
+        deg_results = deg_fleet.run(
+            deg_trace, use_arrival_times=True, fetch_results=False
+        )
+    deg_lats = sorted(1000.0 * r.latency for r in deg_results if r.ok)
+    deg_p50 = deg_lats[len(deg_lats) // 2] if deg_lats else float("nan")
+    deg_p95 = (
+        deg_lats[min(int(len(deg_lats) * 0.95), len(deg_lats) - 1)]
+        if deg_lats
+        else float("nan")
+    )
+    deg_stats = deg_fleet.stats()
+    deg_replayed = deg_stats["sessions_replayed_total"]
+    deg_dropped = deg_fleet.swap_report()["swap_dropped_requests"]
+
     # ---- zero-shot end-to-end (VERDICT r05 #7): the composed generate →
     # label → aggregate path — the workload the generation engine exists
     # for. Resident prompts (the production zero-shot path), the shipped
@@ -1631,6 +1692,12 @@ def main():
                 "fleet_router_split": fleet_split,
                 "fleet_promotions": fleet_swap["promotions"],
                 "fleet_swap_held_peak": fleet_swap["held_peak"],
+                # Degraded-fleet detail (r15): the replica-kill replay behind
+                # the headline fleet_degraded_* / fleet_evicted_* tail keys.
+                "fleet_degraded_requests": len(deg_results),
+                "fleet_degraded_p50_latency_ms": round(deg_p50, 1),
+                "fleet_degraded_evictions": len(deg_stats["evictions"]),
+                "fleet_degraded_dropped_requests": deg_dropped,
                 "width1024_n_params": wide_params,
                 "zeroshot_subjects": zs_subjects,
                 "zeroshot_num_samples": ZS_SAMPLES,
@@ -1650,6 +1717,16 @@ def main():
                 "generate_wasted_decode_frac": round(generate_wasted_frac, 4),
                 "engine_p50_latency_ms": round(engine_p50, 1),
                 "service_p50_latency_ms": round(service_p50, 1),
+                # Detail keys displaced from the tail by the r15 degraded-
+                # fleet headline pair (their adjacent headline companions —
+                # engine_events_per_sec_per_chip / kvq_engine_* — stay in
+                # the tail, and both ratios are recoverable from them).
+                "engine_vs_generate_ratio": round(
+                    engine_rate / max(gen_arm_rate, 1e-9), 3
+                ),
+                "kvq_vs_float_engine_ratio": round(
+                    kvq_rate / max(engine_rate, 1e-9), 3
+                ),
                 # Detail keys displaced from the tail by the r12 fleet
                 # headline triple (their adjacent headline companions stay
                 # in the tail).
@@ -1713,9 +1790,6 @@ def main():
                 # prompt_i) through the engine vs the PR4 padded-cohort
                 # generate() path.
                 "engine_events_per_sec_per_chip": round(engine_rate, 1),
-                "engine_vs_generate_ratio": round(
-                    engine_rate / max(gen_arm_rate, 1e-9), 3
-                ),
                 "engine_p95_latency_ms": round(engine_p95, 1),
                 # r09 lever 2: fused sampling tail (filter+gumbel+argmax+
                 # active-merge in one scope, Pallas on chip) vs the r07
@@ -1728,9 +1802,6 @@ def main():
                 # budget, allocation-free accounting) is the capacity half
                 # that caps production batch size.
                 "kvq_engine_events_per_sec_per_chip": round(kvq_rate, 1),
-                "kvq_vs_float_engine_ratio": round(
-                    kvq_rate / max(engine_rate, 1e-9), 3
-                ),
                 "kvq_slots_per_chip_ratio": kvq_slots_ratio,
                 # Speculative decoding headline (r13): K-event draft +
                 # one-pass verify vs one-event-per-forward decode on the
@@ -1768,6 +1839,15 @@ def main():
                     fleet_p95 / max(service_p95, 1e-9), 3
                 ),
                 "swap_dropped_requests": fleet_swap["swap_dropped_requests"],
+                # Degraded-fleet headline (r15): the SAME trace with one of
+                # the two replicas killed at the midpoint chunk — the fleet
+                # evicts it, replays its sessions on the survivor from
+                # their bound keys (bit-identity + zero-drop pinned in
+                # tests/test_serving_faults.py), and these keys measure
+                # what the failure cost: the degraded tail latency and the
+                # number of sessions the eviction had to replay.
+                "fleet_degraded_p95_latency_ms": round(deg_p95, 1),
+                "fleet_evicted_sessions_replayed": deg_replayed,
                 # Streaming sharded ETL A/B (r11): the parallel host
                 # pipeline vs the single-process r05 baseline on the same
                 # 20k-subject corpus, byte-identical artifacts (tier-1

@@ -326,6 +326,7 @@ def aot_surface() -> dict[str, set[str]]:
         },
         "generation": {"generation:ci"},
         "engine": {f"engine:{k}" for k in pc.canonical_engine_programs(8)}
+        | {f"engine_nohealth:{k}" for k in pc.canonical_nohealth_engine_programs(8)}
         | {f"engine_kvq:{k}" for k in pc.canonical_kvq_engine_programs(8)}
         | {f"engine_sampling:{k}" for k in pc.canonical_sampling_engine_program()}
         | {f"engine_spec:{k}" for k in pc.canonical_spec_engine_programs(8)}

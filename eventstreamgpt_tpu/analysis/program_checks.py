@@ -41,6 +41,7 @@ __all__ = [
     "canonical_generation_program",
     "canonical_engine_programs",
     "canonical_kvq_engine_programs",
+    "canonical_nohealth_engine_programs",
     "canonical_sampling_engine_program",
     "canonical_spec_engine_programs",
     "canonical_spec_engine_na_programs",
@@ -300,6 +301,42 @@ def canonical_kvq_engine_programs(n_data: int = 8) -> dict:
         min_bucket=8,
         mesh=mesh,
         kv_cache_dtype="int8",
+    )
+    return engine.aot_programs(bucket_len=8, group=2)
+
+
+def canonical_nohealth_engine_programs(n_data: int = 8) -> dict:
+    """The engine with the decode health sentinel OFF — the uninstrumented
+    counterpart of `canonical_engine_programs` (whose engine carries the
+    production default ``health_sentinel=True``). Both register against
+    the SAME committed ``engine_dp8`` / ``engine_prefill_dp8`` collective
+    budgets: the sentinel must add **zero collectives and zero host
+    transfers** (its detection is row-local elementwise work and its
+    health row rides the existing packed boundary readback) — the serving
+    mirror of PR 3's ``pretrain:dp8`` vs ``pretrain:dp8_health`` contract.
+    A sentinel implementation that gathered across slots or smuggled a
+    callback would break the byte-identical-budget gate here."""
+    import jax
+
+    from ..serving import GenerationEngine
+    from ..training.sharding import make_mesh
+
+    ge = _graft_entry()
+    _require_devices(n_data)
+    mesh = make_mesh(n_data, 1)
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=2 * n_data,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        mesh=mesh,
+        health_sentinel=False,
     )
     return engine.aot_programs(bucket_len=8, group=2)
 
@@ -661,6 +698,13 @@ def run_program_checks(
     # committed collective budget below.
     for label, (fn, args) in canonical_engine_programs(8).items():
         programs[f"engine:{label}"] = (fn, args)
+    # The health-sentinel contract (ISSUE 15, the serving mirror of the
+    # dp8-vs-dp8_health pretrain gate): the engine above carries the
+    # production default health_sentinel=True; this uninstrumented variant
+    # is held to the SAME committed budgets below — the sentinel must add
+    # zero collectives and zero host transfers.
+    for label, (fn, args) in canonical_nohealth_engine_programs(8).items():
+        programs[f"engine_nohealth:{label}"] = (fn, args)
     # The r09 quantized-decode engine (int8 cache, fused-XLA sampling on
     # the sharded mesh): the decode hot loop with quantize-on-write /
     # dequantize-on-read gates against its own committed budget.
@@ -715,6 +759,10 @@ def run_program_checks(
         budget_keys["pretrain:na_pallas_dp8"] = "na_pallas_dp8"
         budget_keys["engine:decode"] = "engine_dp8"
         budget_keys["engine:prefill_b8"] = "engine_prefill_dp8"
+        # Uninstrumented vs instrumented: byte-identical budgets, per the
+        # health-sentinel zero-collective/zero-transfer contract.
+        budget_keys["engine_nohealth:decode"] = "engine_dp8"
+        budget_keys["engine_nohealth:prefill_b8"] = "engine_prefill_dp8"
         budget_keys["engine_kvq:decode"] = "engine_kvq_dp8"
         budget_keys["engine_kvq:prefill_b8"] = "engine_kvq_prefill_dp8"
         budget_keys["engine_sampling:decode"] = "engine_sampling_1dev"

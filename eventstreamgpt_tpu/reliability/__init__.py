@@ -16,6 +16,11 @@ deterministic test harness, instead of a dead or silently poisoned run:
   code orchestrators can treat as "reschedule me".
 * `faults` — a deterministic fault-injection plan so every recovery path
   above is exercised on CPU in CI.
+* `serving_faults` — the serving-side plan (slot NaN injection, replica
+  hang/death, corrupt shadow checkpoints, flip failures), keyed on chunk
+  indices and service ids so the serving recovery paths (`serving/` slot
+  quarantine, fleet eviction + replay, promotion rollback) are exercised
+  the same deterministic way.
 
 See ``docs/reliability.md`` for the operator-facing contract.
 """
@@ -31,6 +36,14 @@ from .faults import (
 )
 from .integrity import ReliableCheckpointManager, retry_transient
 from .preemption import EXIT_PREEMPTED, GracefulShutdown, Preempted
+from .serving_faults import (
+    ServingFault,
+    ServingFaultPlan,
+    active_serving_fault_plan,
+    clear_serving_fault_plan,
+    install_serving_fault_plan,
+    serving_fault_plan,
+)
 from .sentinel import (
     DivergenceError,
     DivergenceSentinel,
@@ -50,11 +63,17 @@ __all__ = [
     "ReliableCheckpointManager",
     "RollbackController",
     "SentinelConfig",
+    "ServingFault",
+    "ServingFaultPlan",
     "active_fault_plan",
+    "active_serving_fault_plan",
     "clear_fault_plan",
+    "clear_serving_fault_plan",
     "corrupt_checkpoint_step",
     "fault_plan",
     "install_fault_plan",
+    "install_serving_fault_plan",
     "retry_transient",
     "rollback_restore",
+    "serving_fault_plan",
 ]

@@ -2,9 +2,18 @@
 the pod-scale fleet (router tier, prefill stream, hot swap) over those."""
 
 from .engine import GenerationEngine, PrefillHandoff, SlotState, SpecState  # noqa: F401
+from .errors import (  # noqa: F401
+    DeadlineExceeded,
+    MalformedPromptRejected,
+    PromotionError,
+    ReplicaDeadError,
+    ReplicaHungError,
+    ServingError,
+    SlotHealthError,
+)
 from .spec import SpecConfig, truncated_draft  # noqa: F401
-from .fleet import FleetResult, PrefillStream, ServingFleet  # noqa: F401
-from .ingest import IngestedSubject, OnlineIngester  # noqa: F401
+from .fleet import FleetHealthConfig, FleetResult, PrefillStream, ServingFleet  # noqa: F401
+from .ingest import IngestedSubject, OnlineIngester, RejectedSubject  # noqa: F401
 from .router import ConsistentHashRouter, stable_hash  # noqa: F401
 from .scheduler import (  # noqa: F401
     AdmissionRejected,

@@ -95,7 +95,13 @@ from ..models.transformer import (
     time_from_deltas,
 )
 from ..ops.tensor_ops import take_event
-from .scheduler import EngineResult, Request, Scheduler, make_buckets
+from .scheduler import (
+    EngineResult,
+    Request,
+    Scheduler,
+    check_prompt_finite,
+    make_buckets,
+)
 from .spec import SpecConfig, fold_in_event, select_candidate, spec_accept_level
 
 Array = Any
@@ -130,6 +136,13 @@ class SlotState:
     live: Array  # (S,) bool: slot holds an admitted request
     keys: Array  # (S, 2) uint32: per-slot PRNG chains
     active_steps: Array  # () int32: sum over decode steps of active slots
+    # Decode health sentinel (the serving analogue of PR 3's train-step
+    # health vector): sticky per-tenant "non-finite logits/values detected
+    # on device" flag. Set the step the fault appears — the same step also
+    # quarantines the slot (done=True) so the poisoned row freezes — and
+    # read by the host only through the packed boundary readback (zero new
+    # transfers). Admission resets it.
+    health: Array = None  # (S,) bool: non-finite detected for this tenant
 
 
 @struct.dataclass
@@ -281,6 +294,25 @@ class GenerationEngine:
         greedy: deterministic decoding — every head takes its greedy
             statistic (categorical mode, Bernoulli >= 0.5, continuous
             mean) instead of sampling. The PRNG chain is untouched.
+        health_sentinel: the decode health sentinel (production default
+            True; docs/reliability.md "Serving failure domains"): per-slot
+            non-finite logits/values are detected ON DEVICE each step and
+            a health row rides the existing packed boundary readback —
+            zero new host transfers, zero new collectives (statically
+            gated against the uninstrumented ``engine_nohealth``
+            budgets). A bad slot quarantines the step it goes bad; its
+            request fails with `serving.errors.SlotHealthError` (or
+            retries, below) and co-resident slots are bit-untouched.
+        health_retries: per-request retry budget after a slot quarantine.
+            The request re-queues at the FRONT of the scheduler with its
+            ORIGINAL bound key materialized, so a successful retry is
+            bit-identical to an unpoisoned run. 0 (default) fails loudly
+            on the first quarantine.
+        validate_prompts: reject prompts carrying non-finite observed
+            values/times/start times at `submit` with a typed
+            `MalformedPromptRejected` (counted in ``padding_report``) —
+            before an admission index binds, so a dirty request can never
+            poison a slot or perturb the admitted set's keys.
         kv_cache_dtype: the decode KV-cache element type. ``None`` keeps
             the model compute dtype (the parity-exact default); ``"bf16"``
             / ``"fp32"`` pin a float width; ``"int8"`` (and ``"fp8"``
@@ -317,11 +349,33 @@ class GenerationEngine:
         kv_cache_dtype: str | None = None,
         spec: Optional[SpecConfig] = None,
         greedy: bool = False,
+        health_sentinel: bool = True,
+        health_retries: int = 0,
+        validate_prompts: bool = True,
     ):
         self.model = model
         self.params = params
         self.config = config
         self.greedy = bool(greedy)
+        # Decode health sentinel (docs/reliability.md "Serving failure
+        # domains"): per-slot non-finite detection computed inside the
+        # decode/verify programs and read back on the existing packed
+        # boundary (zero new host transfers, zero new collectives — the
+        # detection is row-local elementwise work, statically gated like
+        # PR 3's pretrain:dp8_health). A bad slot quarantines on device the
+        # step it goes bad; its request fails with a typed `SlotHealthError`
+        # or — with health_retries > 0 — is re-queued and re-prefilled from
+        # its bound key (bit-deterministic: the key was fixed at accept).
+        self.health_sentinel = bool(health_sentinel)
+        self.health_retries = int(health_retries)
+        self.validate_prompts = bool(validate_prompts)
+        # Fault-injection scope (reliability/serving_faults.py): the fleet
+        # stamps each service's engines with the service id; None = only
+        # scope-less faults match. Plain host metadata, never traced.
+        self.fault_scope: Optional[str] = None
+        self._health_quarantined = 0
+        self._health_failed = 0
+        self._health_retried = 0
         self.n_slots = int(n_slots)
         self.max_len = int(max_len)
         self.decode_chunk = int(decode_chunk)
@@ -556,35 +610,45 @@ class GenerationEngine:
         self._prefill_compute_jits: dict[tuple[int, int], Any] = {}
         self._admit_jits: dict[int, Any] = {}
         self._extract_jits: dict[int, Any] = {}
-        # Packs done/cursor/base_len/n_generated into ONE (4, n_slots)
-        # array so the boundary readback is a single async host copy. Spec
-        # engines pack (6, n_slots): the per-tenant proposed/accepted
-        # counters ride the same copy, so per-request acceptance accounting
-        # costs zero extra transfers.
+        # Packs done/cursor/base_len/n_generated (+ the health row) into ONE
+        # (5, n_slots) array so the boundary readback is a single async host
+        # copy. Spec engines pack (7, n_slots): the per-tenant proposed/
+        # accepted counters ride the same copy, so per-request acceptance
+        # accounting costs zero extra transfers. The health row rides the
+        # SAME pack — the sentinel adds zero host transfers by construction.
+        health_rows = (
+            [lambda st: st.health.astype(jnp.int32)] if self.health_sentinel else []
+        )
         if spec is None:
+            base_rows = [
+                lambda st: st.done.astype(jnp.int32),
+                lambda st: st.cursor,
+                lambda st: st.base_len,
+                lambda st: st.n_generated,
+            ]
+            rows = base_rows + health_rows
             self._pack_boundary_jit = jax.jit(
-                lambda st: jnp.stack(
-                    [
-                        st.done.astype(jnp.int32),
-                        st.cursor,
-                        st.base_len,
-                        st.n_generated,
-                    ]
-                )
+                lambda st: jnp.stack([r(st) for r in rows])
             )
+            self._boundary_health_row = 4 if self.health_sentinel else None
         else:
-            self._pack_boundary_jit = jax.jit(
-                lambda st, sp: jnp.stack(
-                    [
-                        st.done.astype(jnp.int32),
-                        st.cursor,
-                        st.base_len,
-                        st.n_generated,
-                        sp.proposed,
-                        sp.accepted,
-                    ]
-                )
+            base_rows2 = [
+                lambda st, sp: st.done.astype(jnp.int32),
+                lambda st, sp: st.cursor,
+                lambda st, sp: st.base_len,
+                lambda st, sp: st.n_generated,
+                lambda st, sp: sp.proposed,
+                lambda st, sp: sp.accepted,
+            ]
+            rows2 = base_rows2 + (
+                [lambda st, sp: st.health.astype(jnp.int32)]
+                if self.health_sentinel
+                else []
             )
+            self._pack_boundary_jit = jax.jit(
+                lambda st, sp: jnp.stack([r(st, sp) for r in rows2])
+            )
+            self._boundary_health_row = 6 if self.health_sentinel else None
 
         # Host-side slot table: slot -> Request or None. `live`/`done` on
         # device gate compute; occupancy/harvest bookkeeping lives here.
@@ -670,6 +734,7 @@ class GenerationEngine:
             live=jnp.zeros((S,), bool),
             keys=jnp.zeros((S, 2), jnp.uint32),
             active_steps=jnp.zeros((), jnp.int32),
+            health=jnp.zeros((S,), bool),
         )
 
     def _init_spec_state(self) -> SpecState:
@@ -797,6 +862,35 @@ class GenerationEngine:
 
         return jax.tree_util.tree_map(f, new, old)
 
+    def _rows_nonfinite(self, *trees) -> Array:
+        """Per-slot any-non-finite over the float leaves of row-major
+        pytrees (the health sentinel's detector). Row-local elementwise
+        work + a per-row reduce: no cross-slot ops, so the instrumented
+        decode program carries a collective inventory byte-identical to
+        the uninstrumented one (statically gated, the PR 3 contract)."""
+        bad = jnp.zeros((self.n_slots,), bool)
+        for tree in trees:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                if not (
+                    hasattr(leaf, "dtype")
+                    and jnp.issubdtype(leaf.dtype, jnp.floating)
+                ):
+                    continue
+                if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != self.n_slots:
+                    continue
+                bad = bad | ~jnp.isfinite(leaf.reshape(self.n_slots, -1)).all(axis=1)
+        return bad
+
+    def _apply_health(self, st: SlotState, active, bad, done, health) -> tuple:
+        """Folds a step's detection into (done, health): a bad slot
+        quarantines (its row freezes under the next step's where(active)
+        merges) and its sticky health bit rides the boundary pack. With an
+        all-finite step ``bad`` is all-False and both outputs equal their
+        inputs bitwise — co-residents of a quarantined slot, and every slot
+        of a clean run, are untouched (pinned by test)."""
+        hit = active & bad
+        return done | hit, health | hit
+
     def _merge_caches(self, active, new, old):
         if self._is_na:
             seq = self._merge_rows(active, new.seq_past, old.seq_past)
@@ -831,6 +925,11 @@ class GenerationEngine:
             active
             & self._row_done(big, cursor, st.base_len, n_generated, st.budget)
         )
+        health = st.health
+        if self.health_sentinel:
+            done, health = self._apply_health(
+                st, active, self._rows_nonfinite(preds_last, sample), done, health
+            )
         return st.replace(
             big=big,
             caches=caches,
@@ -838,6 +937,7 @@ class GenerationEngine:
             n_generated=n_generated,
             keys=keys,
             done=done,
+            health=health,
             active_steps=st.active_steps + active.sum(),
         )
 
@@ -870,6 +970,11 @@ class GenerationEngine:
         big = append_new_event(st.big, sample, config, st.cursor)
         n_generated = st.n_generated + (active & sample.event_mask)
         past = out.past_key_values
+        bad = (
+            self._rows_nonfinite(preds_last, sample)
+            if self.health_sentinel
+            else None
+        )
 
         for level in range(1, n_levels):
             keys, step_keys = _vmap_split(keys)
@@ -886,6 +991,8 @@ class GenerationEngine:
             preds_last = _slice_preds_at(out.preds, jnp.asarray(0))
             em_last = take_event(big.event_mask, st.cursor)
             sample = self._sample_rows(preds_last, em_last, step_keys, active=active)
+            if bad is not None:
+                bad = bad | self._rows_nonfinite(preds_last, sample)
             big = update_last_event_data(
                 big,
                 sample,
@@ -904,6 +1011,9 @@ class GenerationEngine:
             active
             & self._row_done(big, cursor, st.base_len, n_generated, st.budget)
         )
+        health = st.health
+        if bad is not None:
+            done, health = self._apply_health(st, active, bad, done, health)
         return st.replace(
             big=big,
             caches=caches,
@@ -911,6 +1021,7 @@ class GenerationEngine:
             n_generated=n_generated,
             keys=keys,
             done=done,
+            health=health,
             active_steps=st.active_steps + active.sum(),
         )
 
@@ -1188,6 +1299,14 @@ class GenerationEngine:
         big = self._merge_rows(active & needs_corr, big1, st.big)
 
         st2, sp2 = self._spec_advance(st, sp, active, big, m, needs_corr)
+        if self.health_sentinel:
+            # The verify forward's preds score every committed event this
+            # round — non-finite anywhere in a row's window quarantines
+            # that slot exactly like the baseline decode step would.
+            done2, health2 = self._apply_health(
+                st, active, self._rows_nonfinite(out.preds), st2.done, st2.health
+            )
+            st2 = st2.replace(done=done2, health=health2)
         caches = self._merge_caches(active, out.past_key_values, st.caches)
         caches = tuple(
             kv.replace(length=jnp.where(active, st2.cursor - 1, kv.length))
@@ -1420,6 +1539,11 @@ class GenerationEngine:
             big = self._merge_rows(needs_walk & (l_sel < level), big1, big)
 
         st2, sp2 = self._spec_advance(st, sp, active, big, m, needs_corr)
+        if self.health_sentinel:
+            done2, health2 = self._apply_health(
+                st, active, self._rows_nonfinite(out.preds), st2.done, st2.health
+            )
+            st2 = st2.replace(done=done2, health=health2)
         # Seq caches: walk rows take the re-contextualize forward's write at
         # the correction position; everyone else keeps the verify pass's.
         # Final per-row length is uniformly cursor' - 1 (the baseline decode
@@ -1686,6 +1810,7 @@ class GenerationEngine:
             done=state.done.at[slots].set(done1, mode="drop"),
             live=state.live.at[slots].set(True, mode="drop"),
             keys=state.keys.at[slots].set(keys1, mode="drop"),
+            health=state.health.at[slots].set(False, mode="drop"),
         )
 
     # ------------------------------------------------------- spec prefill
@@ -1895,6 +2020,40 @@ class GenerationEngine:
             self._extract_jits[group] = jax.jit(fn)
         return self._extract_jits[group]
 
+    # ------------------------------------------------------ fault injection
+    def _poison_jit(self, n: int):
+        """The NaN-injection program (`reliability/serving_faults.py`
+        ``nan_slot``): writes NaN into the chosen slots' last committed
+        event's ``time_delta``, so their NEXT forward produces non-finite
+        logits/values through the time embedding — driving the health
+        sentinel exactly the way a real on-device numerics fault would.
+        Row-local by construction (rows never mix in any decode op), so
+        co-resident slots are bit-untouched. Compiled lazily and only when
+        a plan is installed; deliberately NOT part of `aot_programs` — it
+        is a test harness, not a serving program."""
+        jits = getattr(self, "_poison_jits", None)
+        if jits is None:
+            jits = self._poison_jits = {}
+        if n not in jits:
+
+            def poison(state: SlotState, slots):
+                # The delta BEHIND the last committed event: it feeds the
+                # cumulative-time input of every later forward (the last
+                # event's own delta is overwritten by the next append and
+                # never consumed — poisoning it would be a silent no-op).
+                cols = jnp.maximum(state.cursor[slots] - 2, 0)
+                td = state.big.time_delta.at[slots, cols].set(
+                    jnp.nan, mode="drop"
+                )
+                return state.replace(big=state.big.replace(time_delta=td))
+
+            jits[n] = jax.jit(
+                poison,
+                donate_argnums=(0,),
+                out_shardings=self._state_out_shardings,
+            )
+        return jits[n]
+
     # ---------------------------------------------------------- host pieces
     def _pad_prompt_row(self, prompt: EventStreamBatch) -> EventStreamBatch:
         """One request row, normalized and padded to the slot buffer length."""
@@ -2065,6 +2224,11 @@ class GenerationEngine:
         pipelined boundary predates any newer admission into a recycled
         slot, and its stale done bit must not harvest the new tenant."""
         done_np = boundary[0].astype(bool)
+        health_np = (
+            boundary[self._boundary_health_row].astype(bool)
+            if self._boundary_health_row is not None
+            else np.zeros(self.n_slots, bool)
+        )
         finished = [
             s
             for s in range(self.n_slots)
@@ -2074,9 +2238,37 @@ class GenerationEngine:
         ]
         if not finished:
             return []
-        if fetch_results:
-            g = self.scheduler.group_size_for(len(finished))
-            slots = jnp.asarray(finished + [0] * (g - len(finished)), jnp.int32)
+        # Health triage BEFORE extraction: a quarantined slot's request is
+        # either re-queued for a deterministic retry from its bound key
+        # (health_retries budget; the key was fixed at accept, so the retry
+        # reproduces exactly what an unpoisoned run would have produced) or
+        # fails loudly with a typed `SlotHealthError` — its garbage row is
+        # never extracted, never returned as content.
+        emit: list[tuple[int, bool]] = []  # (slot, failed)
+        for s in finished:
+            bad = bool(health_np[s]) and self.health_sentinel
+            if bad:
+                self._health_quarantined += 1
+                req = self._table[s]
+                if req.health_retries < self.health_retries:
+                    self._table[s] = None
+                    if req.key is None:
+                        # Materialize the bound key so the re-queued request
+                        # survives re-admission under a NEW admission index
+                        # with its ORIGINAL derivation intact.
+                        req.key = self._request_key(req)
+                    req.health_retries += 1
+                    self._health_retried += 1
+                    self.scheduler.requeue_front(req)
+                    continue
+                self._health_failed += 1
+            emit.append((s, bad))
+        if not emit:
+            return []
+        fetch_slots = [s for s, bad in emit if not bad]
+        if fetch_results and fetch_slots:
+            g = self.scheduler.group_size_for(len(fetch_slots))
+            slots = jnp.asarray(fetch_slots + [0] * (g - len(fetch_slots)), jnp.int32)
             rows, cursors, base_lens, n_gens = self._extract_jit(g)(self._state, slots)
             rows = jax.tree_util.tree_map(
                 lambda x: None if x is None else np.asarray(x), rows
@@ -2084,17 +2276,23 @@ class GenerationEngine:
             cursors = np.asarray(cursors)  # graftcheck: allow GC001 -- result-content harvest readback (fetch mode) by design
             base_lens = np.asarray(base_lens)
             n_gens = np.asarray(n_gens)
+            acct = {
+                s: (int(cursors[i]), int(base_lens[i]), int(n_gens[i]))
+                for i, s in enumerate(fetch_slots)
+            }
+            row_of = {s: i for i, s in enumerate(fetch_slots)}
         else:
             # Accounting-only harvest (offline throughput benches): no
             # second transfer at all — the per-slot accounting already rode
             # the chunk's one packed readback.
             rows = None
-            fin = np.asarray(finished)
-            cursors = boundary[1][fin]
-            base_lens = boundary[2][fin]
-            n_gens = boundary[3][fin]
+            row_of = {}
+            acct = {}
+        for s, _bad in emit:
+            if s not in acct:
+                acct[s] = (int(boundary[1][s]), int(boundary[2][s]), int(boundary[3][s]))
         results = []
-        for i, s in enumerate(finished):
+        for s, bad in emit:
             req = self._table[s]
             self._table[s] = None
             spec_proposed = spec_accepted = 0
@@ -2110,8 +2308,9 @@ class GenerationEngine:
                     accepted=spec_accepted,
                     committed=int(boundary[1][s]) - int(boundary[2][s]),
                 )
-            n_events = int(cursors[i])
-            if rows is not None:
+            n_events, prompt_len, n_gen = acct[s]
+            if rows is not None and s in row_of:
+                i = row_of[s]
                 row = jax.tree_util.tree_map(
                     lambda x: None if x is None else x[i : i + 1], rows
                 )
@@ -2127,22 +2326,42 @@ class GenerationEngine:
                 )
             else:
                 row = None
+            error = None
+            if bad:
+                from .errors import SlotHealthError
+
+                error = SlotHealthError(
+                    f"non-finite logits/values detected in decode slot {s} "
+                    f"(request {req.request_id!r}, admission index "
+                    f"{req.admission_index}); the slot was quarantined at "
+                    f"chunk {chunk_index} and its co-residents are untouched",
+                    request_id=req.request_id,
+                    admission_index=req.admission_index,
+                    slot=s,
+                    chunk_index=chunk_index,
+                )
             results.append(
                 EngineResult(
                     request_id=req.request_id,
                     admission_index=req.admission_index,
                     batch=row,
-                    prompt_len=int(base_lens[i]),
+                    prompt_len=prompt_len,
                     n_events=n_events,
-                    n_generated=int(n_gens[i]),
+                    n_generated=n_gen,
                     completion_time=now,
                     spec_proposed=spec_proposed,
                     spec_accepted=spec_accepted,
+                    error=error,
                 )
             )
         return results
 
     # ------------------------------------------------------------- run loop
+    # THE admission finiteness door (one rule set for engine, service, and
+    # ingester — `scheduler.check_prompt_finite`), re-exported here because
+    # the engine is the canonical place callers look for it.
+    check_prompt_finite = staticmethod(check_prompt_finite)
+
     def submit(self, request: Request) -> Request:
         if request.max_new_events < 1:
             raise ValueError("max_new_events must be >= 1")
@@ -2151,6 +2370,17 @@ class GenerationEngine:
                 f"prompt ({request.prompt_len}) + budget ({request.max_new_events}) "
                 f"exceeds max_len ({self.max_len})"
             )
+        if self.validate_prompts and not request.prompt_validated:
+            reason = self.check_prompt_finite(request.prompt)
+            if reason is not None:
+                from .errors import MalformedPromptRejected
+
+                self.scheduler.note_malformed_reject()
+                raise MalformedPromptRejected(
+                    f"request {request.request_id!r}: {reason} — rejected at "
+                    "the door (no admission index bound; a non-finite prompt "
+                    "would poison its decode slot)"
+                )
         return self.scheduler.submit(request)
 
     @property
@@ -2198,6 +2428,25 @@ class GenerationEngine:
         per boundary (each round commits 1..K+1 events per active slot)
         instead of ``decode_chunk`` single-event steps; the boundary pack
         additionally carries the per-tenant proposed/accepted counters."""
+        from ..reliability import serving_faults as _sfaults
+
+        if _sfaults.active_serving_fault_plan() is not None:
+            # Deterministic fault injection (reliability/serving_faults.py),
+            # keyed on this engine's dispatched-chunk counter — no wall
+            # clock. One `None` check when no plan is installed.
+            _sfaults.maybe_die(self.fault_scope, self._dispatched_chunks)
+            _sfaults.maybe_hang(self.fault_scope, self._dispatched_chunks)
+            poison = [
+                s
+                for s in _sfaults.poison_slots(
+                    self.fault_scope, self._dispatched_chunks
+                )
+                if 0 <= s < self.n_slots and self._table[s] is not None
+            ]
+            if poison:
+                self._state = self._poison_jit(len(poison))(
+                    self._state, jnp.asarray(poison, jnp.int32)
+                )
         if self.spec is not None:
             for _ in range(self.decode_chunk):
                 self._state, self._spec_state, proposals = self._spec_draft_jit(
@@ -2345,11 +2594,59 @@ class GenerationEngine:
                     else jax.jit(lambda p: p)
                 )
             self._shadow_draft_params = self._swap_draft_reshard_memo(new_draft_params)
+        from ..reliability import serving_faults as _sfaults
+
+        # Deterministic corruption injection (a torn/garbled staged
+        # checkpoint); `ServingFleet.promote`'s verification probe must
+        # catch it before any flip. No-op without an installed plan.
+        new_params = _sfaults.maybe_corrupt_shadow(self.fault_scope, new_params)
         self._shadow_params = self._swap_reshard_jit()(new_params)
 
     @property
     def shadow_loaded(self) -> bool:
         return self._shadow_params is not None
+
+    def probe_shadow(self) -> Optional[str]:
+        """Finite-output probe on the staged shadow checkpoint — the
+        promotion verification gate. Runs the bucketed prefill forward
+        (the engine's own program shape, on the engine's own template) on
+        the SHADOW weights and checks every float output leaf finite.
+        Returns ``None`` when healthy, else a reason string; never touches
+        live slot state or the live weights, so probing under traffic is
+        safe. A spec engine's staged shadow draft is probed through its own
+        prompt forward in the same call."""
+        if self._shadow_params is None:
+            raise RuntimeError("no shadow checkpoint loaded (call load_shadow first)")
+        t = self._template
+        Lb = min(t.sequence_length, self.max_prompt_len)
+        row = self._pad_prompt_row(t.slice((slice(0, 1), slice(0, Lb))))
+        plen = jnp.asarray([Lb], jnp.int32)
+        keys = jnp.zeros((1, 2), jnp.uint32)
+        fwd = self._prefill_forward_na if self._is_na else self._prefill_forward_ci
+        big1, caches1, _, _ = fwd(Lb, self._shadow_params, row, plen, keys)
+
+        def first_nonfinite(tree, what: str) -> Optional[str]:
+            for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+                if leaf is None or not jnp.issubdtype(
+                    jnp.asarray(leaf).dtype, jnp.floating
+                ):
+                    continue
+                if not bool(np.isfinite(np.asarray(leaf)).all()):  # graftcheck: allow GC001 -- promotion-gate verification readback by design
+                    return (
+                        f"staged shadow checkpoint produced non-finite {what} "
+                        f"at {jax.tree_util.keystr(path)}"
+                    )
+            return None
+
+        reason = first_nonfinite(big1, "prompt-forward outputs")
+        if reason is None:
+            reason = first_nonfinite(caches1, "prefill cache values")
+        if reason is None and self._shadow_draft_params is not None:
+            dcaches = self._prefill_draft_forward(
+                Lb, self._shadow_draft_params, row, big1, plen
+            )
+            reason = first_nonfinite(dcaches, "draft prefill cache values")
+        return reason
 
     def flip(self) -> None:
         """Swaps the live and shadow weight pointers — the zero-downtime
@@ -2404,6 +2701,9 @@ class GenerationEngine:
         self._slot_epoch = [0] * self.n_slots
         self._dispatched_chunks = 0
         self._resolved_chunks = 0
+        self._health_quarantined = 0
+        self._health_failed = 0
+        self._health_retried = 0
         self._inflight.clear()
         self.scheduler = Scheduler(
             self.n_slots,
@@ -2543,6 +2843,10 @@ class GenerationEngine:
                 "wasted_decode_frac": round(1.0 - active / max(total, 1), 4),
                 "sampling_impl": self.sampling_impl_resolved,
                 "greedy": self.greedy,
+                "health_sentinel": self.health_sentinel,
+                "health_quarantined_total": self._health_quarantined,
+                "health_failed_total": self._health_failed,
+                "health_retried_total": self._health_retried,
                 "slots_report": self.slots_report(),
             }
         )
@@ -2707,6 +3011,13 @@ def _census_programs():
     budget_keys = {
         "engine:decode": "engine_dp8",
         "engine:prefill_b8": "engine_prefill_dp8",
+        # The uninstrumented (health_sentinel=False) engine gates against
+        # the SAME budgets as the instrumented default above — the decode
+        # health sentinel must carry a byte-identical collective inventory
+        # (zero new collectives, zero host transfers; the PR 3
+        # dp8-vs-dp8_health contract on the serving side).
+        "engine_nohealth:decode": "engine_dp8",
+        "engine_nohealth:prefill_b8": "engine_prefill_dp8",
         "engine_kvq:decode": "engine_kvq_dp8",
         "engine_kvq:prefill_b8": "engine_kvq_prefill_dp8",
         "engine_sampling:decode": "engine_sampling_1dev",
@@ -2719,6 +3030,7 @@ def _census_programs():
     out = {}
     for prefix, programs in (
         ("engine", pc.canonical_engine_programs(8)),
+        ("engine_nohealth", pc.canonical_nohealth_engine_programs(8)),
         ("engine_kvq", pc.canonical_kvq_engine_programs(8)),
         ("engine_sampling", pc.canonical_sampling_engine_program()),
         # The r13 speculative-decoding programs: the slot-sharded CI spec

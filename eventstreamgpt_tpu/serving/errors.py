@@ -1,0 +1,104 @@
+"""Typed serving errors: every fault the serving stack can surface.
+
+The fault-tolerance contract (docs/reliability.md "Serving failure
+domains") is that an accepted request either completes bit-identical to a
+clean run or fails **loudly with a typed error** — never a silent drop,
+never a poisoned result returned as if healthy. These classes are those
+typed errors; results carry them on an ``error`` field
+(`scheduler.EngineResult` / `service.ServiceResult` / `fleet.FleetResult`)
+so the zero-drop scoreboard counts failed requests as *completed with an
+error*, keeping the physical ledger at zero.
+
+Hierarchy notes: `MalformedPromptRejected` subclasses the scheduler's
+`AdmissionRejected` (it IS a reject-at-the-door — no admission index is
+bound, so the admitted set's PRNG keys are untouched); everything else
+subclasses `ServingError` and describes a fault *after* acceptance.
+"""
+
+from __future__ import annotations
+
+from .scheduler import AdmissionRejected
+
+__all__ = [
+    "DeadlineExceeded",
+    "MalformedPromptRejected",
+    "PromotionError",
+    "ReplicaDeadError",
+    "ReplicaHungError",
+    "ServingError",
+    "SlotHealthError",
+]
+
+
+class ServingError(RuntimeError):
+    """Base class for post-acceptance serving faults."""
+
+
+class SlotHealthError(ServingError):
+    """Non-finite logits/values were detected in a decode slot on device.
+
+    The slot was quarantined at the chunk boundary where the health row
+    surfaced the fault (the device froze the row the step it went bad);
+    co-resident slots are untouched — rows never mix in any decode op, and
+    the quarantine rides the existing ``where(active)`` merges, so a clean
+    co-resident's bits are identical to an all-clean run (pinned by test).
+    """
+
+    def __init__(self, message: str, *, request_id=None, admission_index=None,
+                 slot=None, chunk_index=None):
+        super().__init__(message)
+        self.request_id = request_id
+        self.admission_index = admission_index
+        self.slot = slot
+        self.chunk_index = chunk_index
+
+
+class MalformedPromptRejected(AdmissionRejected):
+    """The prompt carried non-finite observed values / times and was
+    rejected at submission — before any admission index was bound, so it
+    can never reach a prefill and poison a slot, and the admitted set's
+    key derivation is unchanged (the `AdmissionRejected` contract)."""
+
+
+class DeadlineExceeded(ServingError):
+    """A queued request's per-lane deadline expired before placement.
+
+    Deadlines cancel **queued** requests only: once a request is placed on
+    a replica its admission work is already bound, and cancelling it could
+    not return its slot without a recompile-free eviction path — so a
+    resident request always runs to completion. Cancellation never reuses
+    or reassigns the expired request's admission index (indices burn
+    monotonically), so co-admitted requests' PRNG keys never drift.
+    """
+
+    def __init__(self, message: str, *, lane=None, deadline_s=None, waited_s=None):
+        super().__init__(message)
+        self.lane = lane
+        self.deadline_s = deadline_s
+        self.waited_s = waited_s
+
+
+class ReplicaDeadError(ServingError):
+    """A replica's dispatch path died (device lost, injected death fault).
+
+    Raised from the engine's dispatch hooks; the fleet's health monitor
+    converts it into an eviction (`ServingFleet`) and replays the dead
+    service's in-flight sessions on survivors from their bound keys.
+    """
+
+
+class ReplicaHungError(ServingError):
+    """A replica exceeded the bounded boundary-readback timeout (hung
+    dispatch watchdog). Like `ReplicaDeadError`, handled by eviction."""
+
+
+class PromotionError(ServingError):
+    """A fleet checkpoint promotion failed and was rolled back.
+
+    Either the shadow verification gate (finite-output probe on the staged
+    weights) rejected the checkpoint before any flip, or a flip failed
+    mid-fleet — in both cases the fleet rolls back onto the live weights
+    via the hot-swap double buffer (`drop_shadow`, flipping back any
+    already-flipped services) and keeps serving; no accepted request is
+    dropped (`swap_report`).
+    """

@@ -52,7 +52,14 @@ import jax
 import jax.numpy as jnp
 
 from ..data.types import EventStreamBatch
+from ..reliability import serving_faults as _sfaults
 from .engine import GenerationEngine, _as_raw_key, derive_request_key
+from .errors import (
+    PromotionError,
+    ReplicaDeadError,
+    ReplicaHungError,
+    SlotHealthError,
+)
 from .router import ConsistentHashRouter
 from .scheduler import Request
 from .service import ServiceResult, ServingService
@@ -148,6 +155,16 @@ class PrefillStream:
                 raise ValueError(
                     "the prefill replica must be dedicated — it cannot also be "
                     f"decode replica {i}"
+                )
+            if e.health_retries > 0:
+                raise ValueError(
+                    f"decode replica {i} has health_retries={e.health_retries}: "
+                    "health-sentinel retries re-queue on the replica's OWN "
+                    "scheduler, which a dedicated prefill stream never drains "
+                    "(decode replicas compile zero prefill programs) — the "
+                    "retry would hang the service. Behind a prefill stream, "
+                    "quarantined requests must fail loudly: set "
+                    "health_retries=0 (the default)"
                 )
             if e.max_len != self.engine.max_len:
                 raise ValueError(
@@ -254,6 +271,48 @@ class PrefillStream:
 
 
 # ------------------------------------------------------------------ fleet
+@dataclasses.dataclass(frozen=True)
+class FleetHealthConfig:
+    """Replica-health policy for the fleet's liveness monitor.
+
+    Args:
+        boundary_timeout_s: hung-dispatch watchdog — the bounded
+            boundary-readback timeout. A service whose scheduling round
+            (one ``step``: dispatch + the blocking resolve of its oldest
+            boundary readback) exceeds this wall bound is declared hung
+            (`ReplicaHungError`) and evicted. ``None`` disables the
+            watchdog (CI machines stall unpredictably; enable it with a
+            bound calibrated to the deployment's chunk wall time).
+        watchdog_warmup_chunks: the watchdog engages only once every decode
+            replica of a service has dispatched more than this many chunks:
+            a replica's FIRST dispatches pay jit compiles (seconds on a
+            cold program set), which are slow-but-healthy — the watchdog
+            exists for hangs in the steady state, where a round is
+            milliseconds. Benches that pre-warm programs can set 0.
+        max_consecutive_bad_chunks: a service whose rounds harvest
+            health-quarantined slots (`SlotHealthError` results) this many
+            times in a row is declared sick and evicted — one bad slot is
+            a slot-level fault (quarantined, retried/failed per-request);
+            a *streak* means the replica's numerics are gone.
+        auto_evict: evict automatically from the run loop. ``False`` only
+            records faults (`stats()["replica_faults"]`) — the operator
+            calls `ServingFleet.evict_service` themselves.
+    """
+
+    boundary_timeout_s: Optional[float] = None
+    watchdog_warmup_chunks: int = 2
+    max_consecutive_bad_chunks: int = 3
+    auto_evict: bool = True
+
+    def __post_init__(self):
+        if self.boundary_timeout_s is not None and self.boundary_timeout_s <= 0:
+            raise ValueError("boundary_timeout_s must be positive")
+        if self.watchdog_warmup_chunks < 0:
+            raise ValueError("watchdog_warmup_chunks must be >= 0")
+        if self.max_consecutive_bad_chunks < 1:
+            raise ValueError("max_consecutive_bad_chunks must be >= 1")
+
+
 @dataclasses.dataclass
 class FleetResult:
     """A finished fleet request: the engine result plus fleet routing
@@ -272,6 +331,18 @@ class FleetResult:
     n_generated: int
     arrival_time: float
     completion_time: float
+    # Typed fault or None (`serving/errors.py`); faulted requests complete
+    # WITH their error — the zero-drop ledger counts them done.
+    error: Any = None
+    # How many times this request was replayed onto a survivor after a
+    # replica eviction (0 on an undisturbed run). Replays re-prefill from
+    # the request's bound key, so the result content is bit-identical to
+    # an uninterrupted run either way.
+    replays: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
 
     @property
     def latency(self) -> float:
@@ -294,6 +365,11 @@ class ServingFleet:
             the router actually sends it.
         n_vnodes: virtual nodes per service on the router ring.
         default_lane: lane used when ``submit``/``run`` carry none.
+        health: replica liveness policy (`FleetHealthConfig`). When set,
+            the run loop evicts dead/hung/sick services
+            (`evict_service` — router removal + deterministic session
+            replay on survivors from bound keys). ``None`` records
+            nothing and never auto-evicts — existing behavior exactly.
     """
 
     def __init__(
@@ -303,6 +379,7 @@ class ServingFleet:
         base_key: Optional[jax.Array] = None,
         n_vnodes: int = 64,
         default_lane: Optional[str] = None,
+        health: Optional[FleetHealthConfig] = None,
     ):
         if not isinstance(services, Mapping):
             services = {f"svc{i}": s for i, s in enumerate(services)}
@@ -332,10 +409,27 @@ class ServingFleet:
         self._completed_total = 0
         # Hot-swap state machine (see `promote`).
         self._promotion: Optional[dict] = None
+        self._promotion_failed: Optional[str] = None
         self._holding: set[str] = set()
         self._held: dict[str, deque] = {sid: deque() for sid in self.services}
         self._held_peak = 0
         self._swap_history: list[dict] = []
+        # Replica health: liveness policy, per-service bad-round streaks,
+        # the fault/eviction ledgers, and the evicted service objects
+        # (kept for post-mortem `stats`, off the ring and out of the loop).
+        self.health = health
+        self._bad_streak: dict[str, int] = {sid: 0 for sid in self.services}
+        self._replica_faults: list[dict] = []
+        self._evictions: list[dict] = []
+        self._evicted_services: dict[str, ServingService] = {}
+        self._replayed_total = 0
+        # Fault-injection scope (reliability/serving_faults.py): every
+        # engine of service ``sid`` answers to scope ``sid``, so a plan
+        # can target one replica of the fleet deterministically.
+        for sid, svc in self.services.items():
+            for eng in self._service_engines(svc):
+                if eng.fault_scope is None:
+                    eng.fault_scope = sid
 
     # ------------------------------------------------------------- routing
     def route(self, subject_key: Any) -> str:
@@ -364,8 +458,22 @@ class ServingFleet:
             )
         if lane not in svc.lanes.configs:
             raise KeyError(f"unknown lane {lane!r} on service {sid!r}")
+        # The finiteness door runs at the FLEET for every path — a held
+        # (swap-window) request bypasses svc.submit until its post-flip
+        # release, and a malformed prompt must reject before an index
+        # binds, not explode out of the release loop chunks later.
+        if svc.replicas[0].validate_prompts and not request.prompt_validated:
+            reason = GenerationEngine.check_prompt_finite(request.prompt)
+            if reason is not None:
+                from .errors import MalformedPromptRejected
+
+                self._rejected_total += 1
+                raise MalformedPromptRejected(
+                    f"request {request.request_id!r}: {reason} — rejected at "
+                    "the fleet door (no fleet index bound)"
+                )
         index = self._next_index
-        internal = dataclasses.replace(request, request_id=index)
+        internal = dataclasses.replace(request, request_id=index, prompt_validated=True)
         if internal.key is None:
             internal.key = self._request_key(index)
         if sid in self._holding:
@@ -398,6 +506,14 @@ class ServingFleet:
             "service": sid,
             "request_id": request.request_id,
             "arrival": request.arrival_time,
+            # The keyed internal request + lane are retained until
+            # completion so an evicted replica's in-flight sessions can be
+            # replayed on survivors from their BOUND keys — the determinism
+            # contract makes the replayed results bit-identical to an
+            # uninterrupted run.
+            "request": internal,
+            "lane": lane,
+            "replays": 0,
         }
         return True
 
@@ -405,6 +521,9 @@ class ServingFleet:
         meta = self._meta.pop(sr.request_id)
         self._completed_total += 1
         svc = self.services[sid]
+        version = (
+            svc.replicas[sr.replica].weights_version if sr.replica >= 0 else -1
+        )
         return FleetResult(
             request_id=meta["request_id"],
             subject=meta["subject"],
@@ -412,14 +531,124 @@ class ServingFleet:
             lane=sr.lane,
             replica=sr.replica,
             fleet_index=sr.request_id,
-            weights_version=svc.replicas[sr.replica].weights_version,
+            weights_version=version,
             batch=sr.batch,
             prompt_len=sr.prompt_len,
             n_events=sr.n_events,
             n_generated=sr.n_generated,
             arrival_time=meta["arrival"],
             completion_time=sr.completion_time,
+            error=sr.error,
+            replays=meta["replays"],
         )
+
+    # ----------------------------------------------------- replica health
+    def _note_replica_fault(self, sid: str, kind: str, reason: str, error=None):
+        """Records a replica fault and (policy permitting) evicts the sick
+        service. Raises when nothing can be done — a fleet whose LAST
+        service is dead cannot degrade gracefully, it is down."""
+        self._replica_faults.append({"service": sid, "kind": kind, "reason": reason})
+        if self.health is not None and not self.health.auto_evict:
+            # Record-only mode still cannot step a DEAD service forever —
+            # its in-flight work keeps the loop busy and every iteration
+            # re-raises from dispatch: a livelock, not an operator choice.
+            # Hung/sick services make (slow/degraded) progress, so for
+            # them recording really is enough.
+            if kind == "dead":
+                raise error if error is not None else ReplicaDeadError(
+                    f"service {sid!r} is dead ({reason}) and auto_evict is "
+                    "off — call evict_service yourself or enable auto_evict"
+                )
+            return
+        if len(self.services) == 1:
+            raise error if error is not None else ReplicaDeadError(
+                f"the last service {sid!r} is {kind} ({reason}); no survivors "
+                "to evict onto — the fleet is down"
+            )
+        self.evict_service(sid, reason=f"{kind}: {reason}")
+
+    def evict_service(self, sid: str, reason: str = "operator eviction") -> int:
+        """Evicts a sick service and replays its in-flight sessions on the
+        survivors. Returns the number of sessions replayed.
+
+        The sequence: (1) `ConsistentHashRouter.remove_service` drops the
+        service's vnodes — its arcs fall to the ring successors, so ONLY
+        its subjects remap and no survivor session ever re-prefills (the
+        router movement contract, pinned in ``tests/test_fleet.py``);
+        (2) every session the fleet accepted for the evicted service and
+        has not completed — lane-queued, held for a swap, resident
+        mid-decode — is re-routed on the shrunk ring and re-submitted from
+        its **bound key** (``fold_in(fleet_key, i)``, fixed at accept).
+        Re-routed requests re-prefill from scratch on the survivor; the
+        determinism contract (results are functions of prompt/budget/key/
+        max_len only) makes the replayed results **bit-identical to an
+        uninterrupted run**. Replays bypass survivor lane bounds
+        (``force`` — bouncing already-accepted work would be a drop; the
+        overshoot is bounded by the evicted service's in-flight count).
+
+        The evicted `ServingService` object is parked in
+        ``stats()["evicted_services"]`` and never stepped again — results
+        it might still produce are abandoned; the replay owns those
+        sessions now.
+        """
+        if sid not in self.services:
+            raise KeyError(f"service {sid!r} is not part of the fleet")
+        self.router.remove_service(sid)
+        svc = self.services.pop(sid)
+        self._evicted_services[sid] = svc
+        self._bad_streak.pop(sid, None)
+        self._holding.discard(sid)
+        # Promotion bookkeeping: a promotion (or rollback) referencing the
+        # evicted service must not wait on it forever.
+        p = self._promotion
+        if p is not None:
+            if p.get("draining") == sid:
+                p["draining"] = None
+            if sid in p.get("flipped", []):
+                p["flipped"].remove(sid)
+            rb = p.get("rollback")
+            if rb is not None:
+                if rb.get("unflipping") == sid:
+                    rb["unflipping"] = None
+                if sid in rb.get("to_unflip", []):
+                    rb["to_unflip"].remove(sid)
+        # Collect every in-flight session of the evicted service: its held
+        # queue plus every accepted-not-completed fleet index routed to it.
+        held = self._held.pop(sid, deque())
+        indices = sorted(
+            i for i, m in self._meta.items() if m["service"] == sid
+        )
+        replayed = 0
+        for i in indices:
+            meta = self._meta[i]
+            new_sid = self.route(meta["subject"])  # the shrunk ring
+            replay = dataclasses.replace(
+                meta["request"], admission_index=-1, health_retries=0
+            )
+            if new_sid in self._holding:
+                # The survivor is draining for a promotion flip (or a
+                # rollback flip-back): joining its held queue keeps the
+                # hold invariant intact — the replay releases with the
+                # rest of the held routes after the flip, instead of
+                # re-prefilling on weights the flip is about to replace.
+                self._held[new_sid].append((replay, meta["lane"]))
+                self._held_peak = max(
+                    self._held_peak, sum(len(q) for q in self._held.values())
+                )
+            else:
+                accepted = self.services[new_sid].submit(
+                    replay, meta["lane"], force=True
+                )
+                assert accepted  # force bypasses the lane bound
+            meta["service"] = new_sid
+            meta["replays"] += 1
+            replayed += 1
+        del held  # entries are already in _meta[i]; nothing else to carry
+        self._replayed_total += replayed
+        self._evictions.append(
+            {"service": sid, "reason": reason, "replayed": replayed}
+        )
+        return replayed
 
     # ------------------------------------------------------------ hot swap
     def promote(
@@ -432,11 +661,19 @@ class ServingFleet:
 
         Loads ``new_params`` into every engine's shadow buffer (decode
         replicas and prefill replicas alike — all must be ``hot_swap``
-        engines), then flips services one at a time: routes to the flipping
+        engines), runs the **shadow verification gate** — a finite-output
+        probe on every engine's staged weights (`probe_shadow`), so a
+        torn/garbled checkpoint rolls back via `drop_shadow` BEFORE any
+        flip, with a loud `PromotionError` (idle call) or a
+        ``rolled_back`` ``swap_history`` entry (armed under traffic) —
+        then flips services one at a time: routes to the flipping
         service hold at the fleet, residents complete on the old weights,
         the drained engines flip at a chunk boundary, held requests
-        release. Post-flip admissions run wholly on the new checkpoint —
-        bit-identical to a fresh service built on it.
+        release. A flip failing mid-fleet rolls every already-flipped
+        service back onto the old weights still held in its shadow buffer
+        (the double buffer is the rollback). Post-flip admissions run
+        wholly on the new checkpoint — bit-identical to a fresh service
+        built on it.
 
         Called idle (between runs), the whole state machine executes
         synchronously before returning. Called with ``at_time`` (or while a
@@ -475,13 +712,18 @@ class ServingFleet:
             "draft_params": new_draft_params,
             "at_time": at_time,
             "loaded": False,
+            "verified": False,
             "draining": None,
             "flipped": [],
             "held_released": 0,
+            "rollback": None,
         }
+        self._promotion_failed = None
         if at_time is None and not self._any_busy():
             while self._promotion is not None:
                 self._advance_promotion()
+            if self._promotion_failed is not None:
+                raise PromotionError(self._promotion_failed)
 
     @staticmethod
     def _service_engines(svc: ServingService) -> list[GenerationEngine]:
@@ -494,19 +736,43 @@ class ServingFleet:
         p = self._promotion
         if p is None:
             return
+        if p["rollback"] is not None:
+            self._advance_rollback()
+            return
         if not p["loaded"]:
             # Phase 1: stage the checkpoint into every shadow buffer
             # fleet-wide (the HBM was reserved at engine construction);
             # spec engines stage their shadow draft in the same pass.
-            for svc in self.services.values():
-                for eng in self._service_engines(svc):
-                    eng.load_shadow(
-                        p["params"],
-                        new_draft_params=(
-                            p["draft_params"] if eng.spec is not None else None
-                        ),
-                    )
+            try:
+                for svc in self.services.values():
+                    for eng in self._service_engines(svc):
+                        eng.load_shadow(
+                            p["params"],
+                            new_draft_params=(
+                                p["draft_params"] if eng.spec is not None else None
+                            ),
+                        )
+            except Exception as e:
+                self._start_rollback(f"shadow load failed: {e}")
+                return
             p["loaded"] = True
+        if not p["verified"]:
+            # Phase 2 — the shadow verification gate: a finite-output probe
+            # on EVERY engine's staged weights (prompt forward on the
+            # shadow buffer; live state untouched) BEFORE any flip. A
+            # torn/garbled checkpoint rolls the whole promotion back here —
+            # the fleet keeps serving the live weights and no service ever
+            # runs a single decode step on the bad tree.
+            for sid in sorted(self.services):
+                for eng in self._service_engines(self.services[sid]):
+                    reason = eng.probe_shadow()
+                    if reason is not None:
+                        self._start_rollback(
+                            f"shadow verification failed on service {sid!r}: "
+                            f"{reason}"
+                        )
+                        return
+            p["verified"] = True
         if p["draining"] is None:
             remaining = [
                 sid for sid in sorted(self.services) if sid not in p["flipped"]
@@ -514,6 +780,7 @@ class ServingFleet:
             if not remaining:
                 self._swap_history.append(
                     {
+                        "status": "promoted",
                         "services": list(p["flipped"]),
                         "held_released": p["held_released"],
                     }
@@ -526,26 +793,106 @@ class ServingFleet:
         svc = self.services[sid]
         if svc.busy():
             return  # residents still draining on the old weights
-        for eng in self._service_engines(svc):
-            eng.flip()
+        flipped_engines: list[GenerationEngine] = []
+        try:
+            _sfaults.maybe_fail_flip(sid)
+            for eng in self._service_engines(svc):
+                eng.flip()
+                flipped_engines.append(eng)
+        except Exception as e:
+            # A flip failed mid-fleet: flip this (drained) service's
+            # already-flipped engines straight back — the old weights are
+            # still in their shadow buffers, that is what the double
+            # buffer is FOR — then roll the whole promotion back
+            # (services flipped in earlier rounds drain and flip back the
+            # same way; see `_advance_rollback`).
+            for eng in flipped_engines:
+                eng.flip()
+            self._start_rollback(f"flip failed on service {sid!r}: {e}")
+            return
         p["flipped"].append(sid)
         self._holding.discard(sid)
+        self._release_held(sid)
+        p["draining"] = None
+
+    def _release_held(self, sid: str) -> None:
+        """Releases a service's held routes. Capacity was reserved against
+        the lane bound at accept time, but an eviction replay may have
+        legitimately force-overshot a survivor's lane in the meantime — so
+        the release is forced too: a held request was ACCEPTED, and
+        bouncing it on a transiently-full lane would be exactly the drop
+        the zero-drop contract forbids (`swap_report` would read it)."""
+        svc = self.services[sid]
+        p = self._promotion
         held = self._held[sid]
         while held:
             req, lane = held.popleft()
-            accepted = svc.submit(req, lane)
-            if not accepted:
-                # Capacity was reserved against the lane bound at accept
-                # time, so this is unreachable unless that accounting
-                # drifts — and then it must be LOUD in every interpreter
-                # mode (an assert vanishes under -O and the request would
-                # silently vanish with it).
-                raise RuntimeError(
-                    f"held release overflowed lane {lane!r} on service — "
-                    "the zero-drop contract's reservation accounting drifted"
+            accepted = svc.submit(req, lane, force=True)
+            assert accepted  # force bypasses the lane bound
+            if p is not None:
+                p["held_released"] += 1
+
+    # --------------------------------------------------- promotion rollback
+    def _start_rollback(self, reason: str) -> None:
+        """Arms the rollback leg of the promotion state machine: services
+        already flipped will drain and flip BACK (their shadow buffers
+        still hold the old weights — the rollback the double buffer
+        exists to make possible), every staged shadow is dropped, held
+        routes release onto the live (old) weights, and the failure is
+        recorded loudly (`PromotionError` from an idle `promote`;
+        ``swap_report``/`stats` for an armed one). Zero accepted requests
+        are dropped on the way."""
+        p = self._promotion
+        p["rollback"] = {
+            "reason": reason,
+            "to_unflip": list(p["flipped"]),
+            "unflipping": None,
+        }
+        if p["draining"] is not None:
+            # The currently-draining service never flipped; stop holding
+            # its routes and release its backlog onto the old weights.
+            sid = p["draining"]
+            self._holding.discard(sid)
+            self._release_held(sid)
+            p["draining"] = None
+
+    def _advance_rollback(self) -> None:
+        p = self._promotion
+        rb = p["rollback"]
+        if rb["unflipping"] is None:
+            if not rb["to_unflip"]:
+                # Finish: drop every staged shadow (the bad checkpoint),
+                # release any straggler held routes, record, and clear.
+                for svc in self.services.values():
+                    for eng in self._service_engines(svc):
+                        eng.drop_shadow()
+                for sid in sorted(self.services):
+                    if self._held[sid]:
+                        self._release_held(sid)
+                self._holding.clear()
+                self._swap_history.append(
+                    {
+                        "status": "rolled_back",
+                        "reason": rb["reason"],
+                        "services": [],
+                        "held_released": p["held_released"],
+                    }
                 )
-            p["held_released"] += 1
-        p["draining"] = None
+                self._promotion_failed = rb["reason"]
+                self._promotion = None
+                return
+            rb["unflipping"] = rb["to_unflip"][0]
+            self._holding.add(rb["unflipping"])
+        sid = rb["unflipping"]
+        svc = self.services[sid]
+        if svc.busy():
+            return  # residents draining (on the new weights they started on)
+        for eng in self._service_engines(svc):
+            eng.flip()  # the shadow still holds the OLD weights: flip back
+        rb["to_unflip"].remove(sid)
+        rb["unflipping"] = None
+        self._holding.discard(sid)
+        self._release_held(sid)
 
     def swap_report(self) -> dict:
         """The zero-drop scoreboard: accepted minus completed minus still
@@ -588,6 +935,7 @@ class ServingFleet:
         *,
         use_arrival_times: bool = False,
         fetch_results: bool = True,
+        shutdown: Optional[Any] = None,
     ) -> list[FleetResult]:
         """Serves ``items`` — each ``(subject, Request)`` or
         ``(subject, Request, lane)`` — to completion across the fleet and
@@ -599,35 +947,147 @@ class ServingFleet:
         service one scheduling round. With ``use_arrival_times`` the items
         are a replay trace against the fleet clock (the Poisson benchmark
         mode; rejected requests just don't appear in the results).
+
+        Each round also runs the **replica health monitor**
+        (`FleetHealthConfig`): a service whose step raises
+        `ReplicaDeadError`, overruns the hung-dispatch watchdog's bounded
+        boundary-readback timeout, or harvests quarantined slots
+        ``max_consecutive_bad_chunks`` rounds in a row is evicted
+        (`evict_service`) and its in-flight sessions replay on survivors
+        from their bound keys — every accepted request still completes
+        bit-identical to an uninterrupted run or surfaces a typed error.
+
+        ``shutdown`` (a `reliability.GracefulShutdown`) drains resident
+        slots on SIGTERM and raises `reliability.Preempted` with the
+        completed results attached — the serving side of the documented
+        exit-code-85 contract (see `ServingService.run`).
         """
+        from .errors import MalformedPromptRejected
+
         trace = [it if len(it) == 3 else (*it, None) for it in items]
         if not use_arrival_times:
             for subject, req, lane in trace:
-                self.submit(subject, req, lane)
+                try:
+                    self.submit(subject, req, lane)
+                except MalformedPromptRejected:
+                    pass  # typed, counted at the fleet door; others serve on
             trace = []
         results: list[FleetResult] = []
         t0 = time.perf_counter()
         ptr = 0
+        draining = False
 
-        while ptr < len(trace) or self._any_busy() or self._promotion is not None:
-            now = time.perf_counter() - t0
-            while ptr < len(trace) and trace[ptr][1].arrival_time <= now:
-                self.submit(*trace[ptr])
-                ptr += 1
-            if self._promotion is not None and (
-                self._promotion["at_time"] is None
-                or now >= self._promotion["at_time"]
+        while True:
+            if shutdown is not None and shutdown.requested:
+                draining = True
+            if draining:
+                if not any(s.resident_busy() for s in self.services.values()):
+                    break
+            elif not (
+                ptr < len(trace)
+                or self._any_busy()
+                or self._promotion is not None
             ):
-                self._advance_promotion()
+                break
+            now = time.perf_counter() - t0
+            if not draining:
+                while ptr < len(trace) and trace[ptr][1].arrival_time <= now:
+                    try:
+                        self.submit(*trace[ptr])
+                    except MalformedPromptRejected:
+                        pass  # typed per-request reject; never aborts the run
+                    ptr += 1
+                if self._promotion is not None and (
+                    self._promotion["at_time"] is None
+                    or now >= self._promotion["at_time"]
+                ):
+                    self._advance_promotion()
             progressed = False
             for sid in sorted(self.services):
                 svc = self.services[sid]
-                for sr in svc.step(lambda: time.perf_counter() - t0, fetch_results):
+                t_step = time.perf_counter()
+                try:
+                    step_results = svc.step(
+                        lambda: time.perf_counter() - t0,
+                        fetch_results,
+                        place=not draining,
+                    )
+                except ReplicaDeadError as e:
+                    # Replica death mid-dispatch: results this round may be
+                    # lost with the service, but their sessions are still
+                    # in the fleet ledger — the eviction replays every one.
+                    # With no health policy installed the fleet must NOT
+                    # silently change shape: the death propagates, exactly
+                    # the pre-health behavior the `health=None` default
+                    # documents.
+                    if self.health is None:
+                        raise
+                    self._note_replica_fault(sid, "dead", str(e), error=e)
+                    progressed = True
+                    continue
+                step_s = time.perf_counter() - t_step
+                for sr in step_results:
                     results.append(self._wrap(sr, sid))
                 progressed = progressed or svc._last_step_progressed
+                if sid not in self.services:
+                    continue  # evicted by a concurrent path
+                hc = self.health
+                if hc is None:
+                    continue
+                warm = all(
+                    e._dispatched_chunks > hc.watchdog_warmup_chunks
+                    for e in svc.replicas
+                )
+                if (
+                    hc.boundary_timeout_s is not None
+                    and warm
+                    and step_s > hc.boundary_timeout_s
+                ):
+                    self._note_replica_fault(
+                        sid,
+                        "hung",
+                        f"scheduling round took {step_s:.3f}s > "
+                        f"boundary_timeout_s={hc.boundary_timeout_s}s",
+                        error=ReplicaHungError(
+                            f"service {sid!r} exceeded the boundary-readback "
+                            f"timeout ({step_s:.3f}s)"
+                        ),
+                    )
+                    progressed = True
+                    continue
+                # Consecutive-bad-chunk threshold: deadline expiries are
+                # policy, not replica sickness — only quarantined slots
+                # (SlotHealthError) count toward the streak.
+                n_bad = sum(
+                    1 for sr in step_results if isinstance(sr.error, SlotHealthError)
+                )
+                if n_bad:
+                    self._bad_streak[sid] = self._bad_streak.get(sid, 0) + 1
+                    if self._bad_streak[sid] >= hc.max_consecutive_bad_chunks:
+                        self._note_replica_fault(
+                            sid,
+                            "sick",
+                            f"{self._bad_streak[sid]} consecutive rounds "
+                            "harvested health-quarantined slots",
+                        )
+                        progressed = True
+                elif svc._last_step_progressed:
+                    self._bad_streak[sid] = 0
             if not progressed:
                 time.sleep(1e-3)  # waiting on arrivals / drain
-        return sorted(results, key=lambda r: r.fleet_index)
+        results = sorted(results, key=lambda r: r.fleet_index)
+        if draining:
+            from ..reliability.preemption import Preempted
+
+            exc = Preempted(
+                f"fleet preempted: drained {len(results)} completed results; "
+                f"{sum(len(q) for q in self._held.values())} held and "
+                f"{sum(s.lanes.pending for s in self.services.values())} "
+                "queued requests abandoned"
+            )
+            exc.results = results
+            raise exc
+        return results
 
     # ------------------------------------------------------------ accounting
     def stats(self) -> dict:
@@ -637,6 +1097,11 @@ class ServingFleet:
             "accepted_total": self._accepted_total,
             "completed_total": self._completed_total,
             "rejected_total": self._rejected_total,
+            "replica_faults": list(self._replica_faults),
+            "evictions": list(self._evictions),
+            "evicted_services": sorted(self._evicted_services),
+            "sessions_replayed_total": self._replayed_total,
+            "last_promotion_error": self._promotion_failed,
             "swap": self.swap_report(),
             "services": {sid: s.stats() for sid, s in self.services.items()},
         }

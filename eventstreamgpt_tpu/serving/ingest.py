@@ -34,6 +34,7 @@ element in the prompt.
 from __future__ import annotations
 
 import dataclasses
+from collections import deque
 from pathlib import Path
 from typing import Any, Optional
 
@@ -42,9 +43,13 @@ import pandas as pd
 
 from ..data.config import DatasetSchema
 from ..data.types import EventStreamBatch
-from .scheduler import Request
+from .scheduler import Request, check_prompt_finite
 
-__all__ = ["IngestedSubject", "OnlineIngester"]
+__all__ = ["IngestedSubject", "OnlineIngester", "RejectedSubject"]
+
+
+class _MalformedSubject(ValueError):
+    """Internal: one subject's raw values failed admission validation."""
 
 
 @dataclasses.dataclass
@@ -57,6 +62,26 @@ class IngestedSubject:
     prompt: EventStreamBatch
     n_events: int
     n_clipped_observations: int = 0
+
+
+@dataclasses.dataclass
+class RejectedSubject:
+    """One subject whose raw stream failed admission validation: the typed
+    per-request rejection (`serving.errors.MalformedPromptRejected`) a
+    dirty stream produces instead of a prefill that would poison a decode
+    slot. Counted in the ingester's `padding_report`."""
+
+    subject_key: Any
+    subject_id: int
+    reason: str
+
+    @property
+    def error(self):
+        from .errors import MalformedPromptRejected
+
+        return MalformedPromptRejected(
+            f"subject {self.subject_key!r}: {self.reason}"
+        )
 
 
 class OnlineIngester:
@@ -100,6 +125,15 @@ class OnlineIngester:
         # Frozen transform configs are immutable for the ingester's life —
         # built once, shared by every admitted shard.
         self._transform_configs = dataset._frozen_transform_configs()
+        # Admission hardening ledger: subjects whose raw values failed
+        # validation (non-finite times/values) — rejected with a typed
+        # per-request error instead of entering a prefill. The count is
+        # cumulative; the per-subject records keep a bounded recent tail
+        # (a long-lived ingester on a noisy stream must not grow a
+        # per-reject list forever). Surfaced in `padding_report`.
+        self.rejections: deque[RejectedSubject] = deque(maxlen=256)
+        self._malformed_total = 0
+        self._admitted_total = 0
 
     @classmethod
     def from_cache_dir(cls, save_dir: Path | str, **kwargs) -> "OnlineIngester":
@@ -173,6 +207,17 @@ class OnlineIngester:
             lo = n_total - self.max_prompt_events
         n = n_total - lo
 
+        # Admission hardening: a non-finite event time would ride into the
+        # prompt's time_delta and poison the slot's every forward — reject
+        # the subject at the door instead (typed, counted; see `ingest`).
+        # Scope: the cropped window's times feed the served deltas; with
+        # start_time on (the default) the PRE-crop deltas additionally sum
+        # into start_time, so the whole stream must be finite — but a crop
+        # without start_time tolerates ancient-history junk it never reads.
+        checked = times if self.do_include_start_time else times[lo:]
+        if not np.isfinite(checked).all():
+            raise _MalformedSubject("non-finite event time in the raw stream")
+
         M = self.max_n_dynamic
         dyn_idx = np.zeros((1, n, M), dtype=np.int64)
         dyn_meas = np.zeros((1, n, M), dtype=np.int64)
@@ -191,6 +236,13 @@ class OnlineIngester:
                 clipped += k - M
                 ev_i, ev_m, ev_v = ev_i[:M], ev_m[:M], ev_v[:M]
                 k = M
+            # NaN means "unobserved" (masked out below); an INFINITE value
+            # is malformed input that would enter the prompt as an observed
+            # value and poison the slot — reject the subject.
+            if np.isinf(ev_v).any():
+                raise _MalformedSubject(
+                    f"non-finite observed value in event {lo + j}"
+                )
             obs = ~np.isnan(ev_v)
             dyn_idx[0, j, :k] = ev_i
             dyn_meas[0, j, :k] = ev_m
@@ -245,7 +297,24 @@ class OnlineIngester:
                 # the ETL. Nothing to prompt with — skip it, never abort
                 # the rest of the batch.
                 continue
-            prompt, n, clipped = self._collate_row(row)
+            try:
+                prompt, n, clipped = self._collate_row(row)
+                # Belt and braces: the same finiteness door the engine and
+                # service enforce at submit — anything the raw-value checks
+                # above missed (e.g. a non-finite start_time) rejects here,
+                # with the same typed error, instead of at the engine.
+                reason = self._prompt_reject_reason(prompt)
+                if reason is not None:
+                    raise _MalformedSubject(reason)
+            except _MalformedSubject as e:
+                self._malformed_total += 1
+                self.rejections.append(
+                    RejectedSubject(
+                        subject_key=raw_key, subject_id=int(sid), reason=str(e)
+                    )
+                )
+                continue
+            self._admitted_total += 1
             out.append(
                 IngestedSubject(
                     subject_key=raw_key,
@@ -257,6 +326,25 @@ class OnlineIngester:
                 )
             )
         return out
+
+    # THE shared admission finiteness door (`scheduler.check_prompt_finite`
+    # — jax-free, so this host-only module can import it): same fields,
+    # same mask rules as the engine's and the service's submit doors.
+    _prompt_reject_reason = staticmethod(check_prompt_finite)
+
+    def padding_report(self) -> dict:
+        """Admission-hardening counters (named for the engine scheduler's
+        report so serving dashboards merge the two): subjects admitted vs
+        rejected at the door, with a bounded tail of recent per-subject
+        reasons (`rejections` keeps the last 256)."""
+        return {
+            "admitted_subjects": self._admitted_total,
+            "malformed_rejected_total": self._malformed_total,
+            "recent_rejected_subjects": [
+                {"subject": r.subject_key, "reason": r.reason}
+                for r in self.rejections
+            ],
+        }
 
     def requests(
         self,

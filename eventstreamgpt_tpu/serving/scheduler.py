@@ -29,7 +29,36 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Iterable, Optional
 
+import numpy as np
+
 from ..data.types import EventStreamBatch
+
+
+def check_prompt_finite(prompt: EventStreamBatch) -> Optional[str]:
+    """First malformed-value reason in a prompt, or ``None`` if clean.
+
+    THE admission finiteness door, shared verbatim by `GenerationEngine.
+    submit`, `ServingService.submit`, and `OnlineIngester` — one rule set,
+    so the doors cannot drift (a prompt one layer admits is a prompt every
+    layer admits). Checks the floats a prefill actually consumes —
+    ``time_delta`` on real events, ``dynamic_values`` under the observed
+    mask, and ``start_time`` — so legal junk in masked positions never
+    rejects. Host-side numpy on one-row prompts; deliberately jax-free so
+    the host-only ingest path can import it."""
+    em = np.asarray(prompt.event_mask).astype(bool)
+    td = np.asarray(prompt.time_delta)
+    if not np.isfinite(td[em]).all():
+        return "non-finite time_delta on a real event"
+    if prompt.dynamic_values is not None and prompt.dynamic_values_mask is not None:
+        dv = np.asarray(prompt.dynamic_values)
+        m = np.asarray(prompt.dynamic_values_mask).astype(bool)
+        if not np.isfinite(dv[m]).all():
+            return "non-finite observed dynamic_values"
+    if prompt.start_time is not None and not np.isfinite(
+        np.asarray(prompt.start_time)
+    ).all():
+        return "non-finite start_time"
+    return None
 
 
 class AdmissionRejected(RuntimeError):
@@ -60,6 +89,15 @@ class Request:
 
     # Assigned by the scheduler at submission.
     admission_index: int = -1
+    # Health-sentinel retry counter: how many times this request has been
+    # re-queued after a slot quarantine (engine ``health_retries`` budget).
+    # The retry reuses the ORIGINAL bound key, so a successful retry is
+    # bit-identical to an unpoisoned run.
+    health_retries: int = 0
+    # Set by an upstream admission door (`ServingService.submit`) after the
+    # prompt passed `check_prompt_finite`, so the engine door does not
+    # re-scan the same prompt at placement (one scan per request).
+    prompt_validated: bool = dataclasses.field(default=False, repr=False)
 
     @property
     def prompt_len(self) -> int:
@@ -82,6 +120,15 @@ class EngineResult:
     # Zero on non-speculative engines.
     spec_proposed: int = 0
     spec_accepted: int = 0
+    # Typed fault, or None on success (`serving/errors.py`): a request that
+    # hit an unrecoverable fault (slot quarantine past its retry budget,
+    # an expired deadline) completes WITH an error and no content — it is
+    # never silently dropped, and the zero-drop ledger counts it done.
+    error: Any = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
 
 
 def pow2_ceil(n: int) -> int:
@@ -153,6 +200,10 @@ class Scheduler:
         self._rejected = 0
         self._max_depth = 0
         self._prefill_deferrals = 0
+        # Admission hardening: malformed (non-finite) prompts rejected at
+        # the door, and health-sentinel retries re-queued at the front.
+        self._malformed_rejected = 0
+        self._health_requeued = 0
         # Speculative-decoding accounting (engine spec mode): decode-side
         # budgets bind in COMMITTED events — a spec round advances a slot by
         # 1..K+1 of them — so the scheduler tracks commits and where they
@@ -179,6 +230,24 @@ class Scheduler:
         self.queue.append(request)
         self._max_depth = max(self._max_depth, len(self.queue))
         return request
+
+    def note_malformed_reject(self) -> None:
+        """Counts a malformed-prompt rejection (`MalformedPromptRejected`):
+        a reject at the door, before any admission index was bound."""
+        self._malformed_rejected += 1
+        self._rejected += 1
+
+    def requeue_front(self, request: Request) -> None:
+        """Re-queues a health-quarantined request at the FRONT of the
+        admission queue for a deterministic retry. The request keeps its
+        already-bound admission index and key (the caller materialized the
+        key), so the retry — and every other admitted request — reproduces
+        exactly the bits an unpoisoned run would have. Bypasses
+        ``max_pending``: the request was already admitted once; bouncing it
+        here would be dropping admitted work."""
+        self.queue.insert(0, request)
+        self._health_requeued += 1
+        self._max_depth = max(self._max_depth, len(self.queue))
 
     @property
     def pending(self) -> int:
@@ -298,6 +367,8 @@ class Scheduler:
             "queue_depth": len(self.queue),
             "max_queue_depth": self._max_depth,
             "rejected_total": self._rejected,
+            "malformed_rejected_total": self._malformed_rejected,
+            "health_requeued_total": self._health_requeued,
             "prefill_deferrals": self._prefill_deferrals,
             "spec_proposed_events": self._spec_proposed,
             "spec_accepted_events": self._spec_accepted,

@@ -59,7 +59,7 @@ class ServiceResult:
 
     request_id: Any  # the caller's id (the service keys internally)
     lane: str
-    replica: int
+    replica: int  # -1 when the request never reached a replica (expiry)
     admission_index: int  # service-global accept index (the PRNG fold)
     batch: Optional[EventStreamBatch]
     prompt_len: int
@@ -67,6 +67,14 @@ class ServiceResult:
     n_generated: int
     arrival_time: float
     completion_time: float
+    # Typed fault or None (`serving/errors.py`): a faulted request
+    # completes WITH its error — counted done by every ledger, never
+    # silently dropped.
+    error: Any = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
 
     @property
     def latency(self) -> float:
@@ -208,11 +216,17 @@ class ServingService:
     def _request_key(self, index: int):
         return derive_request_key(self._base_key, index)
 
-    def submit(self, request: Request, lane: Optional[str] = None) -> bool:
+    def submit(
+        self, request: Request, lane: Optional[str] = None, force: bool = False
+    ) -> bool:
         """Offers a request to a lane. True ⇒ accepted (an admission index
         and PRNG key are now bound); False ⇒ rejected by lane backpressure
         (counted in `stats`; the request holds no index, so the admitted
-        set's results are unchanged)."""
+        set's results are unchanged). ``force=True`` bypasses the lane
+        bound — the fleet's eviction replay uses it: a replayed session was
+        already accepted once, and bouncing it on a full survivor lane
+        would drop admitted work (the transient overshoot is bounded by
+        the evicted replica's in-flight count)."""
         lane = lane or self.default_lane
         if request.max_new_events < 1:
             raise ValueError("max_new_events must be >= 1")
@@ -222,20 +236,38 @@ class ServingService:
                 f"exceeds max_len ({self.max_len})"
             )
         # Reject BEFORE binding an index: a rejected request must not
-        # perturb the admitted set's key derivation.
+        # perturb the admitted set's key derivation. Malformed (non-finite)
+        # prompts reject here too — at the door, typed, counted — instead
+        # of poisoning a decode slot chunks later.
         if lane not in self.lanes.configs:
             raise KeyError(f"unknown lane {lane!r}")
+        if self.replicas[0].validate_prompts and not request.prompt_validated:
+            reason = GenerationEngine.check_prompt_finite(request.prompt)
+            if reason is not None:
+                from .errors import MalformedPromptRejected
+
+                self.lanes.rejected[lane] += 1
+                raise MalformedPromptRejected(
+                    f"request {request.request_id!r}: {reason} — rejected at "
+                    "the service door (no admission index bound)"
+                )
         cfg = self.lanes.configs[lane]
-        if cfg.max_pending is not None and self.lanes.depth(lane) >= cfg.max_pending:
+        if (
+            not force
+            and cfg.max_pending is not None
+            and self.lanes.depth(lane) >= cfg.max_pending
+        ):
             self.lanes.offer(request, lane)  # counts the reject, won't enqueue
             return False
         index = self._next_index
         self._next_index += 1
-        internal = dataclasses.replace(request, request_id=index)
+        # The prompt passed the door above (or an upstream door already
+        # validated it): placement must not pay the scan again.
+        internal = dataclasses.replace(request, request_id=index, prompt_validated=True)
         if internal.key is None:
             internal.key = self._request_key(index)
-        accepted = self.lanes.offer(internal, lane)
-        assert accepted  # bound was checked above
+        accepted = self.lanes.offer(internal, lane, force=force)
+        assert accepted  # bound was checked above (or force bypassed it)
         self._meta[index] = {
             "lane": lane,
             "request_id": request.request_id,
@@ -300,7 +332,48 @@ class ServingService:
             n_generated=er.n_generated,
             arrival_time=meta["arrival"],
             completion_time=er.completion_time,
+            error=er.error,
         )
+
+    def _expire(self, now: float) -> list[ServiceResult]:
+        """Deadline enforcement: cancels lane-queued requests whose
+        per-lane ``deadline_s`` has passed, each completed with a typed
+        `DeadlineExceeded` — never a silent drop (the physical ledger
+        counts them done). Placed/resident requests are exempt, and the
+        cancelled indices stay burned, so the surviving admitted set's
+        keys — and results — are bit-unchanged (`serving/errors.py`)."""
+        expired = self.lanes.expire(now)
+        if not expired:
+            return []
+        from .errors import DeadlineExceeded
+
+        out = []
+        for lane, req in expired:
+            meta = self._meta.pop(req.request_id)
+            cfg = self.lanes.configs[lane]
+            out.append(
+                ServiceResult(
+                    request_id=meta["request_id"],
+                    lane=lane,
+                    replica=-1,
+                    admission_index=req.request_id,
+                    batch=None,
+                    prompt_len=req.prompt_len,
+                    n_events=0,
+                    n_generated=0,
+                    arrival_time=meta["arrival"],
+                    completion_time=now,
+                    error=DeadlineExceeded(
+                        f"request {meta['request_id']!r} expired after "
+                        f"{now - meta['arrival']:.3f}s queued in lane "
+                        f"{lane!r} (deadline {cfg.deadline_s}s)",
+                        lane=lane,
+                        deadline_s=cfg.deadline_s,
+                        waited_s=now - meta["arrival"],
+                    ),
+                )
+            )
+        return out
 
     # -------------------------------------------------------------- serving
     def run(
@@ -309,6 +382,7 @@ class ServingService:
         *,
         use_arrival_times: bool = False,
         fetch_results: bool = True,
+        shutdown: Optional[Any] = None,
     ) -> list[ServiceResult]:
         """Serves ``requests`` (each a `Request` or ``(Request, lane)``) to
         completion and returns `ServiceResult`s in admission order.
@@ -320,27 +394,84 @@ class ServingService:
         backpressure rejects reflect instantaneous queue depth — the
         Poisson-replay benchmark mode. Rejected requests simply don't
         appear in the results (count in `stats`).
+
+        ``shutdown`` is an optional `reliability.GracefulShutdown`: when a
+        SIGTERM/SIGINT (or a programmatic `request()`) lands, the loop
+        stops admitting — remaining trace arrivals are abandoned and lane
+        backlogs stay unplaced — **drains every resident slot** (placed
+        and reserved-prefill work completes), then raises
+        `reliability.Preempted` with the completed results on
+        ``exc.results``; script drivers convert it to the documented
+        exit-code-85 contract exactly like ``scripts/pretrain.py``.
         """
+        from .errors import MalformedPromptRejected
+
         trace: list[tuple[Request, str]] = [
             r if isinstance(r, tuple) else (r, self.default_lane) for r in requests
         ]
         if not use_arrival_times:
             for req, lane in trace:
-                self.submit(req, lane)
+                try:
+                    self.submit(req, lane)
+                except MalformedPromptRejected:
+                    pass  # typed, counted at the door; the rest still serve
             trace = []
         results: list[ServiceResult] = []
         t0 = time.perf_counter()
         ptr = 0
+        draining = False
 
-        while ptr < len(trace) or self.busy():
+        while True:
+            if shutdown is not None and shutdown.requested:
+                draining = True
+            if draining:
+                if not self.resident_busy():
+                    break
+            elif not (ptr < len(trace) or self.busy()):
+                break
             now = time.perf_counter() - t0
-            while ptr < len(trace) and trace[ptr][0].arrival_time <= now:
-                self.submit(*trace[ptr])
-                ptr += 1
-            results.extend(self.step(lambda: time.perf_counter() - t0, fetch_results))
+            if not draining:
+                while ptr < len(trace) and trace[ptr][0].arrival_time <= now:
+                    try:
+                        self.submit(*trace[ptr])
+                    except MalformedPromptRejected:
+                        # One dirty request in a replay trace is a typed
+                        # per-request reject (already counted by the door),
+                        # never an abort of everyone else's run.
+                        pass
+                    ptr += 1
+            results.extend(
+                self.step(
+                    lambda: time.perf_counter() - t0,
+                    fetch_results,
+                    place=not draining,
+                )
+            )
             if not self._last_step_progressed:
                 time.sleep(1e-3)  # waiting on arrivals
-        return sorted(results, key=lambda r: r.admission_index)
+        results = sorted(results, key=lambda r: r.admission_index)
+        if draining:
+            from ..reliability.preemption import Preempted
+
+            exc = Preempted(
+                f"serving preempted: drained {len(results)} completed "
+                f"results; {self.lanes.pending} queued and "
+                f"{len(trace) - ptr} unarrived requests abandoned"
+            )
+            exc.results = results
+            raise exc
+        return results
+
+    def resident_busy(self) -> bool:
+        """`busy` minus the lane backlogs: work already placed on replicas
+        or reserved on the prefill stream — what a graceful drain waits
+        for (queued-but-unplaced work is abandoned at preemption)."""
+        if self.prefill_stream is not None and self.prefill_stream.pending:
+            return True
+        return any(
+            e.occupied or e.scheduler.pending or e.inflight_chunks
+            for e in self.replicas
+        )
 
     def pending(self) -> int:
         """Requests accepted by THIS service and not yet returned — queued
@@ -362,10 +493,14 @@ class ServingService:
             for e in self.replicas
         )
 
-    def step(self, clock, fetch_results: bool = True) -> list[ServiceResult]:
-        """One scheduling round: place lane picks, pump the prefill stream
-        (dedicated-tier mode), and issue/resolve each replica's pipelined
-        decode chunks. Returns the requests that finished this round.
+    def step(
+        self, clock, fetch_results: bool = True, place: bool = True
+    ) -> list[ServiceResult]:
+        """One scheduling round: expire stale queued requests (deadline
+        lanes), place lane picks, pump the prefill stream (dedicated-tier
+        mode), and issue/resolve each replica's pipelined decode chunks.
+        Returns the requests that finished this round (faulted ones carry
+        their typed ``error``).
 
         ``clock`` is a zero-arg callable returning the service-relative time
         used to stamp completions. Extracted from `run` so an external
@@ -373,12 +508,16 @@ class ServingService:
         multiplex many services without ceding control to any one of them.
         `_last_step_progressed` tells the driver whether anything moved
         (False ⇒ the round was pure polling and a short sleep is in order).
+        ``place=False`` is drain mode (graceful preemption): no new lane
+        picks are placed, but placed/resident work — including reserved
+        prefill-stream entries — still runs to completion.
         """
-        self._place()
-        results: list[ServiceResult] = []
-        progressed = False
+        results: list[ServiceResult] = list(self._expire(clock()))
+        if place:
+            self._place()
+        progressed = bool(results)
         if self.prefill_stream is not None:
-            progressed = self.prefill_stream.pump() > 0
+            progressed = progressed or self.prefill_stream.pump() > 0
         for ri, eng in enumerate(self.replicas):
             if self.prefill_stream is None:
                 eng.plan_and_dispatch(max_padded_events=self.prefill_budget_events)

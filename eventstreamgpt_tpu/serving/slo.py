@@ -52,16 +52,27 @@ class LaneConfig:
             lane while it has queued work (anti-starvation floor for
             low-priority lanes). ``floor(k * min_share)`` slots; 0 means
             the lane only gets leftover capacity.
+        deadline_s: per-lane queueing deadline. A request still QUEUED in
+            this lane ``deadline_s`` seconds after its arrival time is
+            cancelled with a typed `serving.errors.DeadlineExceeded` at the
+            next scheduling round (`LaneQueues.expire`) instead of serving
+            a stale answer. Deadlines never touch placed/resident requests
+            and never reuse a cancelled request's admission index, so the
+            surviving admitted set's PRNG keys cannot drift. ``None`` (the
+            default) disables expiry — existing behavior exactly.
     """
 
     name: str
     priority: int = 0
     max_pending: Optional[int] = None
     min_share: float = 0.0
+    deadline_s: Optional[float] = None
 
     def __post_init__(self):
         if not (0.0 <= self.min_share <= 1.0):
             raise ValueError(f"min_share must be in [0, 1], got {self.min_share}")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(f"deadline_s must be positive, got {self.deadline_s}")
 
 
 DEFAULT_LANES = (
@@ -87,18 +98,21 @@ class LaneQueues:
         self._queues: dict[str, deque] = {l.name: deque() for l in lanes}
         self.accepted = {l.name: 0 for l in lanes}
         self.rejected = {l.name: 0 for l in lanes}
+        self.expired = {l.name: 0 for l in lanes}
         self.max_depth = {l.name: 0 for l in lanes}
         # Fractional min_share reservation credit carried across rounds
         # (resets while the lane is empty — idle time banks nothing).
         self._share_credit = {l.name: 0.0 for l in lanes}
 
-    def offer(self, item: Any, lane: str) -> bool:
-        """Enqueues ``item`` on ``lane``; False ⇒ rejected (lane full)."""
+    def offer(self, item: Any, lane: str, force: bool = False) -> bool:
+        """Enqueues ``item`` on ``lane``; False ⇒ rejected (lane full).
+        ``force=True`` bypasses the bound (eviction replay of
+        already-accepted work — see `ServingService.submit`)."""
         if lane not in self._queues:
             raise KeyError(f"unknown lane {lane!r} (have {list(self.order)})")
         cfg = self.configs[lane]
         q = self._queues[lane]
-        if cfg.max_pending is not None and len(q) >= cfg.max_pending:
+        if not force and cfg.max_pending is not None and len(q) >= cfg.max_pending:
             self.rejected[lane] += 1
             return False
         q.append(item)
@@ -112,6 +126,36 @@ class LaneQueues:
 
     def depth(self, lane: str) -> int:
         return len(self._queues[lane])
+
+    def expire(self, now: float) -> list[tuple[str, Any]]:
+        """Removes and returns every queued item whose lane deadline has
+        passed (``now - item.arrival_time > deadline_s``) — deadline
+        enforcement, run by the service before each admission round.
+
+        Only QUEUED work expires: placement binds device admission state,
+        so a placed request always runs to completion. Expired items keep
+        their already-bound admission indices (burned, never reused) —
+        cancellation can therefore never drift a surviving request's PRNG
+        key. A deadline storm (every queued request expired at once) drains
+        the lane with one typed rejection per request: zero silent drops.
+        """
+        out: list[tuple[str, Any]] = []
+        for name in self.order:
+            cfg = self.configs[name]
+            if cfg.deadline_s is None:
+                continue
+            q = self._queues[name]
+            keep: deque = deque()
+            while q:
+                item = q.popleft()
+                waited = now - getattr(item, "arrival_time", 0.0)
+                if waited > cfg.deadline_s:
+                    self.expired[name] += 1
+                    out.append((name, item))
+                else:
+                    keep.append(item)
+            self._queues[name] = keep
+        return out
 
     def pick(self, k: int) -> list[tuple[str, Any]]:
         """Dequeues up to ``k`` items: ``min_share`` reservations first
@@ -161,10 +205,12 @@ class LaneQueues:
                     "max_queue_depth": self.max_depth[name],
                     "accepted": self.accepted[name],
                     "rejected": self.rejected[name],
+                    "expired": self.expired[name],
                 }
                 for name in self.order
             },
             "accepted_total": total_acc,
             "rejected_total": total_rej,
+            "expired_total": sum(self.expired.values()),
             "reject_frac": round(total_rej / max(total_acc + total_rej, 1), 4),
         }

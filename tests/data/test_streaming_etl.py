@@ -636,6 +636,12 @@ class TestOnlineAdmission:
         engine = GenerationEngine(
             model, params, cfg, template=template, n_slots=2, max_len=8,
             decode_chunk=2, min_bucket=2,
+            # This stack tests the ingest→engine loop, not serving
+            # numerics: the UNTRAINED toy model's log-normal-mixture TTE
+            # head legitimately samples inf at init, which the decode
+            # health sentinel would (correctly) quarantine as a poisoned
+            # slot — docs/reliability.md "Serving failure domains".
+            health_sentinel=False,
         )
         return dict(
             ESD=ESD, raw_one=raw_one, target=target, mrn=mrn,
@@ -718,3 +724,64 @@ class TestOnlineAdmission:
         subs = ing.ingest(make_schema(stack["raw_one"]))
         assert subs[0].prompt.static_indices is None
         assert subs[0].prompt.static_measurement_indices is None
+
+    def test_dirty_stream_produces_typed_rejections_not_poisoned_prompts(
+        self, stack, monkeypatch
+    ):
+        """Admission hardening (ISSUE 15): malformed / non-finite raw event
+        values produce a per-request typed rejection — counted in the
+        ingester's `padding_report` — instead of entering a prefill and
+        poisoning a decode slot. The dirty stream here corrupts the
+        transformed rep (an inf observed value on one subject, a NaN event
+        time on another path of the same subject re-run) at the one point
+        every raw corruption funnels through."""
+        from eventstreamgpt_tpu.serving.ingest import OnlineIngester
+
+        ing = OnlineIngester.from_template(stack["ESD"], stack["template"])
+        schema = make_schema(stack["raw_one"])
+
+        real_transform = OnlineIngester.transform
+
+        def dirty_values(self, input_schema):
+            shard, rep, id_map = real_transform(self, input_schema)
+            for i in rep.index:
+                vals = rep.at[i, "dynamic_values"]
+                if not np.isscalar(vals):
+                    vals[0][0] = float("inf")  # an observed value gone bad
+                    break
+            return shard, rep, id_map
+
+        monkeypatch.setattr(OnlineIngester, "transform", dirty_values)
+        subs = ing.ingest(schema)
+        assert subs == []  # the dirty subject never became a prompt
+        assert len(ing.rejections) == 1
+        rej = ing.rejections[0]
+        assert "non-finite" in rej.reason
+        from eventstreamgpt_tpu.serving import MalformedPromptRejected
+
+        assert isinstance(rej.error, MalformedPromptRejected)
+        report = ing.padding_report()
+        assert report["malformed_rejected_total"] == 1
+        assert report["admitted_subjects"] == 0
+        assert ing.requests(schema, max_new_events=4) == []
+
+        # NaN event times reject the same way (second corruption mode).
+        def dirty_times(self, input_schema):
+            shard, rep, id_map = real_transform(self, input_schema)
+            for i in rep.index:
+                times = rep.at[i, "time"]
+                if not np.isscalar(times):
+                    times[0] = float("nan")
+                    break
+            return shard, rep, id_map
+
+        monkeypatch.setattr(OnlineIngester, "transform", dirty_times)
+        assert ing.ingest(schema) == []
+        assert len(ing.rejections) == 3  # +1 from the requests() call above
+        assert "time" in ing.rejections[-1].reason
+
+        # And the clean stream still admits through the SAME ingester.
+        monkeypatch.setattr(OnlineIngester, "transform", real_transform)
+        clean = ing.ingest(schema)
+        assert len(clean) == 1
+        assert ing.padding_report()["admitted_subjects"] == 1

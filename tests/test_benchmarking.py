@@ -126,6 +126,13 @@ class TestBenchTailCapture:
         "fleet_p95_latency_ms",
         "fleet_vs_service_p95_ratio",
         "swap_dropped_requests",
+        # r15 fault-tolerant-serving verdicts: the same fleet trace with
+        # one replica killed at the midpoint chunk — eviction + bound-key
+        # session replay on the survivor (bit-identity and the zero-drop
+        # scoreboard pinned in tests/test_serving_faults.py); these are
+        # the measured degradation cost.
+        "fleet_degraded_p95_latency_ms",
+        "fleet_evicted_sessions_replayed",
         # r11 streaming-ETL A/B verdicts: the parallel host pipeline vs the
         # single-process r05 baseline on identical work (bit-identical
         # artifacts pinned in tier-1).

@@ -159,6 +159,24 @@ class TestRouterHashStability:
             else:
                 assert after[s] in {"a", "c"}
 
+    def test_eviction_assignment_pinned_by_committed_fixture(self, fixture):
+        """The fleet's eviction path (`ServingFleet.evict_service`) leans on
+        `remove_service` placement being byte-stable across restarts: the
+        post-eviction assignment is pinned by the committed fixture, movers
+        are EXACTLY the evicted service's subjects, and every mover lands
+        on a survivor — survivor sessions never re-prefill."""
+        evicted = fixture["evicted_service"]
+        subjects = sorted(fixture["assignment_4"])
+        router = ConsistentHashRouter(fixture["services_4"], n_vnodes=fixture["n_vnodes"])
+        router.remove_service(evicted)
+        after = router.assignment(subjects)
+        assert after == fixture["assignment_4_evict_svc1"]
+        before = fixture["assignment_4"]
+        survivors = set(fixture["services_4"]) - {evicted}
+        movers = {s for s in subjects if before[s] != after[s]}
+        assert movers == {s for s in subjects if before[s] == evicted}
+        assert all(after[s] in survivors for s in movers)
+
     def test_validation(self):
         with pytest.raises(ValueError, match="duplicate"):
             ConsistentHashRouter(["a", "a"])

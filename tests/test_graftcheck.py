@@ -775,6 +775,55 @@ class TestLoweredProgramGates:
             assert check_no_f64(text, f"engine:{label}") == []
             assert check_no_host_transfers(text, f"engine:{label}") == []
 
+    def test_health_sentinel_engine_shares_budgets_with_uninstrumented(self):
+        """The ISSUE-15 gate, mirroring PR 3's dp8-vs-dp8_health contract on
+        the serving side: the decode health sentinel (production default,
+        health_sentinel=True — the `engine:*` canonical) must add ZERO
+        collectives and ZERO host transfers, so the uninstrumented variant
+        (`engine_nohealth:*`) is wired to the SAME committed budget keys
+        and both must lower clean. The health row rides the existing packed
+        boundary: (5, n_slots) instrumented vs (4, n_slots) without."""
+        import inspect
+
+        import jax
+
+        from eventstreamgpt_tpu.analysis import program_checks as pc
+        from eventstreamgpt_tpu.analysis.program_checks import (
+            canonical_nohealth_engine_programs,
+            check_no_f64,
+            check_no_host_transfers,
+        )
+
+        programs = canonical_nohealth_engine_programs(8)
+        assert set(programs) == {"decode", "prefill_b8", "boundary_pack"}
+        for label, (fn, args) in programs.items():
+            text = fn.lower(*args).as_text()
+            assert check_no_f64(text, f"engine_nohealth:{label}") == []
+            assert check_no_host_transfers(text, f"engine_nohealth:{label}") == []
+        # The uninstrumented boundary pack has no health row; Tier B holds
+        # both decode programs to the SAME committed engine_dp8 budget
+        # (byte-identical inventories — the zero-collective contract).
+        fn, args = programs["boundary_pack"]
+        assert jax.eval_shape(fn, *args).shape[0] == 4
+        src = inspect.getsource(pc.run_program_checks)
+        assert 'budget_keys["engine_nohealth:decode"] = "engine_dp8"' in src
+        assert (
+            'budget_keys["engine_nohealth:prefill_b8"] = "engine_prefill_dp8"' in src
+        )
+
+    def test_instrumented_boundary_pack_carries_the_health_row(self):
+        """The production engine's packed boundary readback grew exactly one
+        row (the per-slot health flags) — the sentinel's only host-visible
+        surface, riding the copy the host already makes every chunk."""
+        import jax
+
+        from eventstreamgpt_tpu.analysis.program_checks import (
+            canonical_engine_programs,
+        )
+
+        fn, args = canonical_engine_programs(8)["boundary_pack"]
+        assert jax.eval_shape(fn, *args).shape[0] == 5
+
     def test_kvq_and_pallas_programs_are_f64_and_host_transfer_free(self):
         """The r09 kernel-round programs: the int8-cache engine decode on
         dp8 (quantize-on-write / dequantize-on-read must add no host

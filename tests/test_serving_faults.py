@@ -1,0 +1,708 @@
+"""Fault-tolerant serving (ISSUE 15; docs/reliability.md "Serving failure
+domains"): the `ServingFaultPlan`-driven recovery suite.
+
+The load-bearing invariants:
+
+* **Slot quarantine**: an injected NaN slot is detected ON DEVICE by the
+  decode health sentinel, quarantined at the chunk boundary, and its
+  request fails with a typed `SlotHealthError` — or retries from its bound
+  key (`health_retries`), reproducing the clean run bit-for-bit —
+  while co-resident slots' outputs stay **bit-identical to a clean run**.
+* **Replica eviction + session replay**: with a plan killing one of two
+  services mid-trace, every accepted request either completes bit-identical
+  to a clean single-service run or surfaces a typed error — zero silent
+  drops (the physical-ledger scoreboard reads 0). Survivor sessions never
+  replay; only the dead service's arcs remap.
+* **Deadline enforcement**: a stalled replica (hang fault) ages the queued
+  backlog past its lane deadline and every expired request surfaces as a
+  typed `DeadlineExceeded` — queued-only, indices burned, survivors'
+  results unperturbed.
+* **Promotion rollback**: a corrupt staged shadow fails the finite-output
+  verification gate BEFORE any flip; a flip failure mid-fleet rolls
+  already-flipped services back on the double buffer. Either way the fleet
+  keeps serving the live checkpoint bit-identically and drops nothing.
+* **Graceful preemption**: SIGTERM during `fleet.run` drains resident
+  slots, returns completed results, and exits 85 (the subprocess contract,
+  matching scripts/pretrain.py).
+
+Plan/policy/typed-error units run in tier-1; everything needing engine
+builds and replays is marked slow (the serving-faults slow-e2e CI chunk).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.reliability import (
+    GracefulShutdown,
+    Preempted,
+    ServingFault,
+    ServingFaultPlan,
+    active_serving_fault_plan,
+    serving_fault_plan,
+)
+from eventstreamgpt_tpu.reliability.serving_faults import corrupt_params_tree
+from eventstreamgpt_tpu.serving import (
+    AdmissionRejected,
+    DeadlineExceeded,
+    FleetHealthConfig,
+    GenerationEngine,
+    LaneConfig,
+    MalformedPromptRejected,
+    PromotionError,
+    ReplicaDeadError,
+    Request,
+    ServingError,
+    ServingFleet,
+    ServingService,
+    SlotHealthError,
+)
+from eventstreamgpt_tpu.serving.slo import LaneQueues
+
+from .test_fleet import build_ci, engine_for
+
+pytestmark = [pytest.mark.serving, pytest.mark.reliability]
+
+MAX_LEN = 8
+
+
+@pytest.fixture(scope="module")
+def ci():
+    return build_ci()
+
+
+def make_request(prompt, i, arrival=0.0):
+    Lp = 3 if i % 2 == 0 else 4
+    return Request(
+        prompt=prompt.slice((slice(i % 4, i % 4 + 1), slice(0, Lp))),
+        max_new_events=MAX_LEN - Lp,
+        request_id=i,
+        arrival_time=arrival,
+    )
+
+
+def assert_same_result_content(a, b):
+    assert a.ok and b.ok
+    assert a.n_events == b.n_events and a.n_generated == b.n_generated
+    for f in ("event_mask", "time_delta", "dynamic_indices", "dynamic_values"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a.batch, f)), np.asarray(getattr(b.batch, f))
+        )
+
+
+# ------------------------------------------------------ plan units (tier-1)
+class TestServingFaultPlanUnits:
+    def test_kind_validation(self):
+        with pytest.raises(ValueError, match="unknown serving fault kind"):
+            ServingFault("meteor_strike")
+        with pytest.raises(ValueError, match="slot and chunk_index"):
+            ServingFault("nan_slot", slot=0)
+        with pytest.raises(ValueError, match="chunk_index"):
+            ServingFault("death")
+        with pytest.raises(ValueError, match="seconds"):
+            ServingFault("hang", chunk_index=1)
+
+    def test_no_plan_hooks_are_noops(self):
+        from eventstreamgpt_tpu.reliability import serving_faults as sf
+
+        assert active_serving_fault_plan() is None
+        assert sf.poison_slots("svc0", 3) == []
+        sf.maybe_hang("svc0", 3)
+        sf.maybe_die("svc0", 3)
+        sf.maybe_fail_flip("svc0")
+        tree = {"w": np.ones(3, np.float32)}
+        assert sf.maybe_corrupt_shadow("svc0", tree) is tree
+
+    def test_nan_slot_scope_and_chunk_matching(self):
+        plan = ServingFaultPlan(
+            [ServingFault("nan_slot", service="svc0", slot=1, chunk_index=2)]
+        )
+        assert plan.poison_slots("svc1", 2) == []
+        assert plan.poison_slots("svc0", 1) == []
+        assert plan.poison_slots("svc0", 2) == [1]
+        assert plan.fired and plan.fired[0]["kind"] == "nan_slot"
+        # service=None matches any scope
+        anyplan = ServingFaultPlan([ServingFault("nan_slot", slot=0, chunk_index=0)])
+        assert anyplan.poison_slots("whatever", 0) == [0]
+
+    def test_death_is_sticky_hang_is_one_shot(self):
+        plan = ServingFaultPlan(
+            [
+                ServingFault("death", service="svc0", chunk_index=2),
+                ServingFault("hang", service="svc0", chunk_index=1, seconds=0.5),
+            ]
+        )
+        assert not plan.is_dead("svc0", 1)
+        assert plan.is_dead("svc0", 2)
+        assert plan.is_dead("svc0", 5)  # dead replicas stay dead
+        assert plan.hang_seconds("svc0", 1) == 0.5
+        assert plan.hang_seconds("svc0", 2) == 0.0  # one-shot
+
+    def test_corrupt_params_tree_poisons_first_float_leaf(self):
+        tree = {"a": np.arange(3, dtype=np.int32), "b": np.ones(4, np.float32)}
+        bad = corrupt_params_tree(tree)
+        assert np.isnan(bad["b"]).any()
+        np.testing.assert_array_equal(bad["a"], tree["a"])
+        assert not np.isnan(tree["b"]).any()  # original untouched
+
+    def test_context_manager_installs_and_clears(self):
+        plan = ServingFaultPlan([])
+        with serving_fault_plan(plan) as p:
+            assert active_serving_fault_plan() is p
+        assert active_serving_fault_plan() is None
+
+
+# -------------------------------------------------- deadline units (tier-1)
+class _Item:
+    def __init__(self, arrival_time):
+        self.arrival_time = arrival_time
+
+
+class TestDeadlinePolicy:
+    def test_deadline_validation(self):
+        with pytest.raises(ValueError, match="deadline_s"):
+            LaneConfig("x", deadline_s=0.0)
+
+    def test_no_deadline_never_expires(self):
+        q = LaneQueues((LaneConfig("a"),))
+        q.offer(_Item(0.0), "a")
+        assert q.expire(now=1e9) == []
+        assert q.pending == 1
+
+    def test_expire_removes_only_stale_queued_items(self):
+        q = LaneQueues((LaneConfig("a", deadline_s=1.0), LaneConfig("b", priority=1)))
+        old, fresh, other = _Item(0.0), _Item(5.0), _Item(0.0)
+        q.offer(old, "a")
+        q.offer(fresh, "a")
+        q.offer(other, "b")  # no deadline on lane b
+        expired = q.expire(now=5.5)
+        assert [(l, i) for l, i in expired] == [("a", old)]
+        assert q.pending == 2
+        rep = q.report()
+        assert rep["lanes"]["a"]["expired"] == 1
+        assert rep["expired_total"] == 1
+        # FIFO within the lane is preserved for survivors
+        assert q.pick(2) == [("a", fresh), ("b", other)]
+
+    def test_force_offer_bypasses_bound(self):
+        q = LaneQueues((LaneConfig("a", max_pending=1),))
+        assert q.offer(_Item(0.0), "a")
+        assert not q.offer(_Item(0.0), "a")
+        assert q.offer(_Item(0.0), "a", force=True)
+        assert q.depth("a") == 2
+
+
+# ----------------------------------------------------- typed errors (tier-1)
+class TestTypedErrors:
+    def test_hierarchy(self):
+        assert issubclass(MalformedPromptRejected, AdmissionRejected)
+        for cls in (
+            SlotHealthError,
+            DeadlineExceeded,
+            ReplicaDeadError,
+            PromotionError,
+        ):
+            assert issubclass(cls, ServingError)
+
+    def test_slot_health_error_carries_context(self):
+        e = SlotHealthError("boom", request_id="r", admission_index=3, slot=1, chunk_index=7)
+        assert (e.request_id, e.admission_index, e.slot, e.chunk_index) == ("r", 3, 1, 7)
+
+    def test_fleet_health_config_validation(self):
+        with pytest.raises(ValueError):
+            FleetHealthConfig(boundary_timeout_s=0.0)
+        with pytest.raises(ValueError):
+            FleetHealthConfig(max_consecutive_bad_chunks=0)
+        with pytest.raises(ValueError):
+            FleetHealthConfig(watchdog_warmup_chunks=-1)
+
+
+# ------------------------------------------- malformed admission (tier-1)
+class TestMalformedPromptRejection:
+    def test_engine_submit_rejects_nonfinite_prompt(self, ci):
+        eng = engine_for(ci)
+        prompt = ci[4]
+        good = make_request(prompt, 0)
+        bad_prompt = good.prompt.replace(
+            time_delta=np.asarray(good.prompt.time_delta).copy() * np.nan
+        )
+        with pytest.raises(MalformedPromptRejected):
+            eng.submit(Request(prompt=bad_prompt, max_new_events=2, request_id="bad"))
+        rep = eng.scheduler.padding_report()
+        assert rep["malformed_rejected_total"] == 1
+        # a clean request still admits — the reject bound no index
+        eng.submit(good)
+        assert good.admission_index == 0
+
+    def test_check_prompt_finite_is_mask_aware(self, ci):
+        prompt = ci[4].slice((slice(0, 1), slice(0, 4)))
+        assert GenerationEngine.check_prompt_finite(prompt) is None
+        dv = np.asarray(prompt.dynamic_values).copy()
+        mask = np.asarray(prompt.dynamic_values_mask)
+        # junk under a False mask is legal ...
+        dirty_unobserved = dv.copy()
+        dirty_unobserved[~mask] = np.inf
+        assert (
+            GenerationEngine.check_prompt_finite(
+                prompt.replace(dynamic_values=dirty_unobserved)
+            )
+            is None
+        )
+        # ... non-finite under a True mask is not
+        if mask.any():
+            dirty = dv.copy()
+            dirty[mask] = np.inf
+            assert "dynamic_values" in GenerationEngine.check_prompt_finite(
+                prompt.replace(dynamic_values=dirty)
+            )
+
+    def test_service_submit_rejects_at_the_door(self, ci):
+        svc = ServingService([engine_for(ci)])
+        prompt = ci[4]
+        bad_prompt = prompt.slice((slice(0, 1), slice(0, 3))).replace(
+            start_time=np.asarray([np.nan], np.float32)
+        )
+        with pytest.raises(MalformedPromptRejected):
+            svc.submit(Request(prompt=bad_prompt, max_new_events=2, request_id="bad"))
+        # no admission index was bound
+        assert svc.pending() == 0 and svc._next_index == 0
+
+
+# ------------------------------------------------- slot quarantine (slow)
+@pytest.mark.slow
+class TestSlotQuarantineE2E:
+    @pytest.fixture(scope="class")
+    def clean(self, ci):
+        eng = engine_for(ci)
+        eng.fault_scope = "svc0"
+        return eng.run([make_request(ci[4], i) for i in range(2)])
+
+    def test_nan_slot_fails_typed_and_co_resident_is_bit_identical(self, ci, clean):
+        eng = engine_for(ci)
+        eng.fault_scope = "svc0"
+        plan = ServingFaultPlan(
+            [ServingFault("nan_slot", service="svc0", slot=0, chunk_index=1)]
+        )
+        with serving_fault_plan(plan):
+            res = eng.run([make_request(ci[4], i) for i in range(2)])
+        assert plan.fired, "the injection never triggered"
+        by_id = {r.request_id: r for r in res}
+        assert isinstance(by_id[0].error, SlotHealthError)
+        assert by_id[0].batch is None  # garbage content is never returned
+        assert by_id[0].error.slot == 0
+        # the co-resident slot's output is bit-identical to the clean run
+        ref = {r.request_id: r for r in clean}
+        assert_same_result_content(ref[1], by_id[1])
+        stats = eng.stats()
+        assert stats["health_quarantined_total"] == 1
+        assert stats["health_failed_total"] == 1
+
+    def test_retry_from_bound_key_reproduces_clean_run_bitwise(self, ci, clean):
+        eng = engine_for(ci, health_retries=1)
+        eng.fault_scope = "svc0"
+        plan = ServingFaultPlan(
+            [ServingFault("nan_slot", service="svc0", slot=0, chunk_index=1)]
+        )
+        with serving_fault_plan(plan):
+            res = eng.run([make_request(ci[4], i) for i in range(2)])
+        ref = {r.request_id: r for r in clean}
+        got = {r.request_id: r for r in res}
+        for rid in (0, 1):
+            assert_same_result_content(ref[rid], got[rid])
+        stats = eng.stats()
+        assert stats["health_retried_total"] == 1
+        assert stats["health_failed_total"] == 0
+        assert eng.scheduler.padding_report()["health_requeued_total"] == 1
+
+    def test_sentinel_off_returns_poisoned_content_silently(self, ci):
+        """The counterfactual the sentinel exists for: with it disabled the
+        poisoned slot runs to completion and hands back garbage as if
+        healthy — exactly the failure mode the default closes."""
+        eng = engine_for(ci, health_sentinel=False)
+        eng.fault_scope = "svc0"
+        plan = ServingFaultPlan(
+            [ServingFault("nan_slot", service="svc0", slot=0, chunk_index=1)]
+        )
+        with serving_fault_plan(plan):
+            res = eng.run([make_request(ci[4], i) for i in range(2)])
+        by_id = {r.request_id: r for r in res}
+        assert by_id[0].ok  # no typed error: the silent-poison hazard
+        assert not np.isfinite(np.asarray(by_id[0].batch.time_delta)).all()
+
+
+# ---------------------------------------- eviction + session replay (slow)
+@pytest.mark.slow
+class TestReplicaDeathEviction:
+    def _items(self, prompt, n=6):
+        return [(f"subject-{i}", make_request(prompt, i)) for i in range(n)]
+
+    def test_kill_one_of_two_replays_bit_identical_with_zero_drops(self, ci):
+        prompt = ci[4]
+        key = jax.random.PRNGKey(7)
+        ref_fleet = ServingFleet(
+            {"svc0": ServingService([engine_for(ci)])}, base_key=key
+        )
+        ref = ref_fleet.run(self._items(prompt))
+
+        fleet = ServingFleet(
+            {
+                "svc0": ServingService([engine_for(ci)]),
+                "svc1": ServingService([engine_for(ci)]),
+            },
+            base_key=key,
+            health=FleetHealthConfig(),
+        )
+        victims = {s for s, _ in self._items(prompt) if fleet.route(s) == "svc0"}
+        assert victims, "trace never routes to the victim service"
+        plan = ServingFaultPlan([ServingFault("death", service="svc0", chunk_index=1)])
+        with serving_fault_plan(plan):
+            res = fleet.run(self._items(prompt))
+
+        # Zero silent drops: every accepted request completed (ok or typed).
+        assert len(res) == len(ref)
+        assert fleet.swap_report()["swap_dropped_requests"] == 0
+        # Every completion is bit-identical to the clean single-service run.
+        ref_by = {r.fleet_index: r for r in ref}
+        for r in res:
+            assert r.ok, r.error
+            assert_same_result_content(ref_by[r.fleet_index], r)
+        # The eviction is recorded and the router ring shrank to survivors.
+        evs = fleet.stats()["evictions"]
+        assert len(evs) == 1 and evs[0]["service"] == "svc0"
+        assert fleet.router.service_ids == ("svc1",)
+        assert "svc0" in fleet.stats()["evicted_services"]
+        # Survivor sessions never replayed; only the dead service's did.
+        for r in res:
+            if r.subject not in victims:
+                assert r.replays == 0
+            assert r.service == "svc1"  # everyone finished on the survivor
+
+    def test_consecutive_bad_chunk_streak_evicts(self, ci):
+        prompt = ci[4]
+        fleet = ServingFleet(
+            {
+                "svc0": ServingService([engine_for(ci)]),
+                "svc1": ServingService([engine_for(ci)]),
+            },
+            base_key=jax.random.PRNGKey(7),
+            health=FleetHealthConfig(max_consecutive_bad_chunks=1),
+        )
+        # Poison a slot on svc0 every early chunk: the harvested
+        # SlotHealthError results trip the streak threshold.
+        plan = ServingFaultPlan(
+            [
+                ServingFault("nan_slot", service="svc0", slot=0, chunk_index=c)
+                for c in range(1, 4)
+            ]
+        )
+        with serving_fault_plan(plan):
+            res = fleet.run(self._items(prompt))
+        assert len(res) == 6
+        assert fleet.swap_report()["swap_dropped_requests"] == 0
+        evs = fleet.stats()["evictions"]
+        assert evs and evs[0]["service"] == "svc0" and "consecutive" in evs[0]["reason"]
+
+    def test_hung_dispatch_watchdog_evicts(self, ci):
+        prompt = ci[4]
+        fleet = ServingFleet(
+            {
+                "svc0": ServingService([engine_for(ci)]),
+                "svc1": ServingService([engine_for(ci)]),
+            },
+            base_key=jax.random.PRNGKey(7),
+            health=FleetHealthConfig(
+                boundary_timeout_s=0.5, watchdog_warmup_chunks=1
+            ),
+        )
+        # Keep the victim busy past its warm-up: route enough subjects to
+        # svc0 that it is still dispatching when the stall fires (its
+        # 2-slot engine serves 5 sessions over well more than 2 chunks).
+        victims = [s for s in (f"subject-{k}" for k in range(60)) if fleet.route(s) == "svc0"][:5]
+        others = [s for s in (f"subject-{k}" for k in range(60)) if fleet.route(s) == "svc1"][:3]
+        items = [
+            (s, make_request(prompt, i))
+            for i, s in enumerate(victims + others)
+        ]
+        plan = ServingFaultPlan(
+            [ServingFault("hang", service="svc0", chunk_index=2, seconds=1.5)]
+        )
+        with serving_fault_plan(plan):
+            res = fleet.run(items)
+        assert plan.fired, "the stall never triggered"
+        assert len(res) == len(items) and all(r.ok for r in res)
+        assert fleet.swap_report()["swap_dropped_requests"] == 0
+        evs = fleet.stats()["evictions"]
+        assert evs and evs[0]["service"] == "svc0" and "hung" in evs[0]["reason"]
+
+    def test_last_service_death_is_loud(self, ci):
+        prompt = ci[4]
+        fleet = ServingFleet(
+            {"svc0": ServingService([engine_for(ci)])},
+            base_key=jax.random.PRNGKey(7),
+            health=FleetHealthConfig(),
+        )
+        plan = ServingFaultPlan([ServingFault("death", service="svc0", chunk_index=1)])
+        with serving_fault_plan(plan), pytest.raises(ReplicaDeadError):
+            fleet.run(self._items(prompt, n=2))
+
+
+# -------------------------------------------------- deadline storm (slow)
+@pytest.mark.slow
+class TestDeadlineStorm:
+    def test_stall_expires_queued_requests_typed_zero_silent_drops(self, ci):
+        svc = ServingService(
+            [engine_for(ci, n_slots=1)],
+            lanes=(LaneConfig("interactive", priority=0, deadline_s=0.4),),
+        )
+        svc.replicas[0].fault_scope = "svc0"
+        plan = ServingFaultPlan(
+            [ServingFault("hang", service="svc0", chunk_index=1, seconds=0.9)]
+        )
+        reqs = [make_request(ci[4], i) for i in range(4)]
+        with serving_fault_plan(plan):
+            res = svc.run(reqs)
+        # every accepted request completed: served or typed-expired
+        assert len(res) == 4
+        expired = [r for r in res if isinstance(r.error, DeadlineExceeded)]
+        served = [r for r in res if r.ok]
+        assert expired and served
+        assert svc.pending() == 0
+        rep = svc.lanes.report()
+        assert rep["expired_total"] == len(expired)
+        for r in expired:
+            assert r.error.lane == "interactive"
+            assert r.error.waited_s > 0.4
+            assert r.batch is None and r.replica == -1
+
+    def test_deadline_expiry_does_not_perturb_survivors(self, ci):
+        """Cancellation burns indices without reuse: the served subset's
+        keys — and results — match the same requests served by a clean
+        engine under the service key derivation."""
+        svc = ServingService(
+            [engine_for(ci, n_slots=1)],
+            lanes=(LaneConfig("interactive", priority=0, deadline_s=0.4),),
+            base_key=jax.random.PRNGKey(3),
+        )
+        svc.replicas[0].fault_scope = "svc0"
+        plan = ServingFaultPlan(
+            [ServingFault("hang", service="svc0", chunk_index=1, seconds=0.9)]
+        )
+        with serving_fault_plan(plan):
+            res = svc.run([make_request(ci[4], i) for i in range(4)])
+        served = [r for r in res if r.ok]
+        # Reference: a clean engine serving ONLY the served admission
+        # indices, with the keys those indices bound at accept time.
+        eng = engine_for(ci, n_slots=1)
+        from eventstreamgpt_tpu.serving.engine import derive_request_key
+
+        ref_reqs = []
+        for r in served:
+            req = make_request(ci[4], r.request_id)
+            req.key = derive_request_key(jax.random.PRNGKey(3), r.admission_index)
+            ref_reqs.append(req)
+        ref = {r.request_id: r for r in eng.run(ref_reqs)}
+        for r in served:
+            assert_same_result_content(ref[r.request_id], r)
+
+
+# ---------------------------------------------- promotion rollback (slow)
+@pytest.mark.slow
+class TestPromotionRollback:
+    def _fleet(self, ci, key=7):
+        return ServingFleet(
+            {
+                "svc0": ServingService([engine_for(ci, hot_swap=True)]),
+                "svc1": ServingService([engine_for(ci, hot_swap=True)]),
+            },
+            base_key=jax.random.PRNGKey(key),
+        )
+
+    def _items(self, prompt, n=4, start=0, arrivals=False):
+        return [
+            (
+                f"subject-{i}",
+                make_request(prompt, i, arrival=0.05 * (i - start) if arrivals else 0.0),
+            )
+            for i in range(start, start + n)
+        ]
+
+    def test_corrupt_shadow_fails_verification_and_rolls_back(self, ci):
+        config, model, params, params2, prompt = ci
+        ref_fleet = self._fleet(ci)
+        ref_a = ref_fleet.run(self._items(prompt, 4, 0))
+        ref_b = ref_fleet.run(self._items(prompt, 4, 4))
+
+        fleet = self._fleet(ci)
+        got_a = fleet.run(self._items(prompt, 4, 0))
+        plan = ServingFaultPlan([ServingFault("corrupt_shadow", service="svc0")])
+        with serving_fault_plan(plan), pytest.raises(
+            PromotionError, match="shadow verification failed"
+        ):
+            fleet.promote(params2)
+        hist = fleet.swap_report()["swap_history"]
+        assert hist and hist[-1]["status"] == "rolled_back"
+        # no flip ever happened; shadows dropped; serving continues
+        # bit-identically on the live (old) weights
+        for svc in fleet.services.values():
+            for eng in svc.replicas:
+                assert eng.weights_version == 0 and not eng.shadow_loaded
+        got_b = fleet.run(self._items(prompt, 4, 4))
+        for a, b in zip(ref_b, got_b):
+            assert_same_result_content(a, b)
+        assert fleet.swap_report()["swap_dropped_requests"] == 0
+
+    def test_flip_failure_mid_fleet_flips_back_on_the_double_buffer(self, ci):
+        config, model, params, params2, prompt = ci
+        ref_fleet = self._fleet(ci)
+        ref_fleet.run(self._items(prompt, 4, 0))
+        ref_b = ref_fleet.run(self._items(prompt, 4, 4))
+
+        fleet = self._fleet(ci)
+        fleet.run(self._items(prompt, 4, 0))
+        # svc0 flips first (sorted order); svc1's flip fails -> svc0 must
+        # flip BACK (its shadow still holds the old weights).
+        plan = ServingFaultPlan([ServingFault("flip_failure", service="svc1")])
+        with serving_fault_plan(plan), pytest.raises(
+            PromotionError, match="flip failed"
+        ):
+            fleet.promote(params2)
+        hist = fleet.swap_report()["swap_history"]
+        assert hist[-1]["status"] == "rolled_back"
+        for svc in fleet.services.values():
+            for eng in svc.replicas:
+                assert not eng.shadow_loaded
+                assert eng.weights_version in (0, 2)  # never flipped / flip+flipback
+        got_b = fleet.run(self._items(prompt, 4, 4))
+        for a, b in zip(ref_b, got_b):
+            assert_same_result_content(a, b)
+        assert fleet.swap_report()["swap_dropped_requests"] == 0
+
+    def test_armed_rollback_under_traffic_drops_nothing(self, ci):
+        config, model, params, params2, prompt = ci
+        fleet = self._fleet(ci)
+        plan = ServingFaultPlan([ServingFault("corrupt_shadow")])
+        trace = self._items(prompt, 8, 0, arrivals=True)
+        fleet.promote(params2, at_time=0.1)
+        with serving_fault_plan(plan):
+            res = fleet.run(trace, use_arrival_times=True)
+        assert len(res) == 8 and all(r.ok for r in res)
+        assert fleet.swap_report()["swap_dropped_requests"] == 0
+        hist = fleet.swap_report()["swap_history"]
+        assert hist and hist[-1]["status"] == "rolled_back"
+        assert fleet.stats()["last_promotion_error"] is not None
+        # every result served on the never-promoted live weights
+        for svc in fleet.services.values():
+            for eng in svc.replicas:
+                assert eng.weights_version == 0 and not eng.shadow_loaded
+
+    def test_successful_promotion_history_carries_status(self, ci):
+        config, model, params, params2, prompt = ci
+        fleet = self._fleet(ci)
+        fleet.promote(params2)
+        hist = fleet.swap_report()["swap_history"]
+        assert hist[-1]["status"] == "promoted"
+        assert sorted(hist[-1]["services"]) == ["svc0", "svc1"]
+
+
+# ------------------------------------------------ graceful drain (slow)
+@pytest.mark.slow
+class TestServingPreemption:
+    def test_in_process_drain_returns_completed_results(self, ci):
+        import threading
+
+        prompt = ci[4]
+        fleet = ServingFleet(
+            {
+                "svc0": ServingService([engine_for(ci)]),
+                "svc1": ServingService([engine_for(ci)]),
+            },
+            base_key=jax.random.PRNGKey(7),
+        )
+        sd = GracefulShutdown()
+        trace = [
+            (f"subject-{i}", make_request(prompt, i, arrival=0.1 * i))
+            for i in range(40)
+        ]
+        threading.Timer(1.5, sd.request).start()
+        with pytest.raises(Preempted) as exc_info:
+            fleet.run(trace, use_arrival_times=True, shutdown=sd)
+        results = exc_info.value.results
+        assert results is not None and all(r.ok for r in results)
+        assert len(results) < 40  # preempted before the trace completed
+
+    def test_sigterm_subprocess_exits_85_with_completed_results(self, tmp_path):
+        """The serving side of the scripts/pretrain.py exit-code contract:
+        a real SIGTERM during fleet.run drains resident slots, the driver
+        converts Preempted into EXIT_PREEMPTED (85)."""
+        driver = tmp_path / "serve_driver.py"
+        driver.write_text(
+            """
+import sys
+sys.path.insert(0, {repo!r})
+import jax
+from eventstreamgpt_tpu.reliability import EXIT_PREEMPTED, GracefulShutdown, Preempted
+from eventstreamgpt_tpu.serving import GenerationEngine, Request, ServingFleet, ServingService
+from tests.test_fleet import build_ci, engine_for
+
+ci = build_ci()
+prompt = ci[4]
+fleet = ServingFleet(
+    {{"svc0": ServingService([engine_for(ci)])}}, base_key=jax.random.PRNGKey(7)
+)
+
+def make_request(i, arrival):
+    Lp = 3 if i % 2 == 0 else 4
+    return Request(
+        prompt=prompt.slice((slice(i % 4, i % 4 + 1), slice(0, Lp))),
+        max_new_events=8 - Lp,
+        request_id=i,
+        arrival_time=arrival,
+    )
+
+trace = [(f"subject-{{i}}", make_request(i, 0.1 * i)) for i in range(200)]
+print("READY", flush=True)
+with GracefulShutdown() as shutdown:
+    try:
+        fleet.run(trace, use_arrival_times=True, shutdown=shutdown)
+    except Preempted as e:
+        print(f"DRAINED {{len(e.results)}}", flush=True)
+        sys.exit(EXIT_PREEMPTED)
+print("UNREACHED", flush=True)
+sys.exit(0)
+""".format(repo=str(Path(__file__).resolve().parents[1]))
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, str(driver)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        try:
+            # Wait for the serving loop to start, then deliver the real
+            # signal the orchestrator would.
+            deadline = time.time() + 300
+            ready = False
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if "READY" in line:
+                    ready = True
+                    break
+            assert ready, "driver never reached the serving loop"
+            time.sleep(3.0)
+            proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=240)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert proc.returncode == 85, f"exit {proc.returncode}; output:\n{out}"
+        assert "DRAINED" in out
+        assert "UNREACHED" not in out
