@@ -330,7 +330,8 @@ def aot_surface() -> dict[str, set[str]]:
         | {f"engine_kvq:{k}" for k in pc.canonical_kvq_engine_programs(8)}
         | {f"engine_sampling:{k}" for k in pc.canonical_sampling_engine_program()}
         | {f"engine_spec:{k}" for k in pc.canonical_spec_engine_programs(8)}
-        | {f"engine_spec_na:{k}" for k in pc.canonical_spec_engine_na_programs()},
+        | {f"engine_spec_na:{k}" for k in pc.canonical_spec_engine_na_programs()}
+        | {f"engine_paged:{k}" for k in pc.canonical_paged_engine_programs(8)},
         "service": {f"service:{k}" for k in pc.canonical_service_programs(8)},
         "fleet": {f"engine_tp:{k}" for k in pc.canonical_tp_engine_programs(4, 2)}
         | {f"engine_swap:{k}" for k in pc.canonical_swap_engine_programs()},

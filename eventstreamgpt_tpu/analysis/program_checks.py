@@ -42,6 +42,7 @@ __all__ = [
     "canonical_engine_programs",
     "canonical_kvq_engine_programs",
     "canonical_nohealth_engine_programs",
+    "canonical_paged_engine_programs",
     "canonical_sampling_engine_program",
     "canonical_spec_engine_programs",
     "canonical_spec_engine_na_programs",
@@ -301,6 +302,45 @@ def canonical_kvq_engine_programs(n_data: int = 8) -> dict:
         min_bucket=8,
         mesh=mesh,
         kv_cache_dtype="int8",
+    )
+    return engine.aot_programs(bucket_len=8, group=2)
+
+
+def canonical_paged_engine_programs(n_data: int = 8) -> dict:
+    """The r16 paged copy-on-write engine programs on the dp8 mesh: the
+    block-pool decode (attention reads through per-slot block tables, one
+    gather per layer), the paged prefill (block-scatter admit), and the
+    fork prefill (ONE batch-1 forward admitting a whole CoW branch group).
+
+    The collective contract: the pool is replicated over the mesh (its
+    leading dim is num_blocks, not n_slots), so decode's pool updates
+    all-gather from the slot-sharded chunk — an all-gather is already in
+    the engine_dp8 kind set, so the block gather adds ZERO new collective
+    kinds on dp8 (the ``engine_paged_dp8`` budget pins the inventory).
+    ``block_size=4`` divides the canonical ``max_len=12`` (3 blocks/slot).
+    """
+    import jax
+
+    from ..serving import GenerationEngine
+    from ..training.sharding import make_mesh
+
+    ge = _graft_entry()
+    _require_devices(n_data)
+    mesh = make_mesh(n_data, 1)
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=2 * n_data,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        mesh=mesh,
+        paged_kv=True,
+        block_size=4,
     )
     return engine.aot_programs(bucket_len=8, group=2)
 
@@ -710,6 +750,12 @@ def run_program_checks(
     # dequantize-on-read gates against its own committed budget.
     for label, (fn, args) in canonical_kvq_engine_programs(8).items():
         programs[f"engine_kvq:{label}"] = (fn, args)
+    # The r16 paged copy-on-write engine: block-pool decode, paged-admit
+    # prefill, and the fork (CoW branch group) prefill, each against its
+    # own committed budget — the decode budget pins "zero new collective
+    # kinds vs engine_dp8" for the block gather.
+    for label, (fn, args) in canonical_paged_engine_programs(8).items():
+        programs[f"engine_paged:{label}"] = (fn, args)
     # The Pallas fused-sampling decode program (unsharded single-replica
     # topology): zero-collective by construction, and the kernel epilogue
     # must stay callback-free.
@@ -765,6 +811,14 @@ def run_program_checks(
         budget_keys["engine_nohealth:prefill_b8"] = "engine_prefill_dp8"
         budget_keys["engine_kvq:decode"] = "engine_kvq_dp8"
         budget_keys["engine_kvq:prefill_b8"] = "engine_kvq_prefill_dp8"
+        budget_keys["engine_paged:decode"] = "engine_paged_dp8"
+        budget_keys["engine_paged:prefill_b8"] = "engine_paged_prefill_dp8"
+        budget_keys["engine_paged:prefill_fork_fwd_b8"] = (
+            "engine_paged_fork_prefill_dp8"
+        )
+        budget_keys["engine_paged:prefill_fork_admit"] = (
+            "engine_paged_fork_admit_dp8"
+        )
         budget_keys["engine_sampling:decode"] = "engine_sampling_1dev"
         budget_keys["engine_spec:draft_chunk"] = "engine_spec_draft_dp8"
         budget_keys["engine_spec:verify"] = "engine_spec_verify_dp8"

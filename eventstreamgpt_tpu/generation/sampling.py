@@ -48,6 +48,18 @@ class GenerativeSequenceModelSamples:
     regression_indices: Optional[dict[str, Array]] = None
 
 
+@jax.custom_batching.custom_vmap
+def _sampling_barrier(x):
+    """`optimization_barrier` with a vmap rule (the stock primitive has none
+    in this jax version): barriers pass through row-batching untouched."""
+    return jax.lax.optimization_barrier(x)
+
+
+@_sampling_barrier.def_vmap
+def _sampling_barrier_vmap(axis_size, in_batched, x):
+    return jax.lax.optimization_barrier(x), in_batched[0]
+
+
 def _named_key(key: jax.Array, name: str) -> jax.Array:
     """A PRNG key derived stably from ``name``.
 
@@ -91,6 +103,17 @@ def sample_head_draws(
     sample_head_draws(...), event_mask)``. Every head's key derives from
     its name (not draw order), so draw ORDER never affects values.
     """
+
+    # The barrier pins every draw's bits against fusion-context
+    # sensitivity: when a head's dense epilogue (ELU rate, mixture params,
+    # logits) is visible in the same XLA program as the sampler, it can
+    # fuse into the draw and compute the distribution parameters 1 ulp off
+    # from a materialized forward. Serving's fork() bit-identity contract
+    # (CoW branch == independent submission) samples across a program
+    # boundary, so every draw must see "materialized" parameters in every
+    # context — engine, generate(), and the evaluator all sample through
+    # here, so their relative parity pins move together.
+    preds = jax.tree_util.tree_map(_sampling_barrier, preds)
 
     def _draw_categorical(dist: Categorical, k: jax.Array) -> Array:
         if greedy:

@@ -106,6 +106,138 @@ class KVCache:
         )
 
 
+@struct.dataclass
+class PagedKVCache:
+    """A block-pool (paged) per-layer key/value cache with per-row tables.
+
+    The serving engine's copy-on-write decode cache: keys/values live in a
+    device-resident pool of fixed-size blocks (``pool_key``/``pool_value``
+    of shape ``(num_blocks, H, block_size, head_dim)``) instead of one
+    monolithic ``(B, max_len)`` buffer per row. Each row owns a
+    ``block_table`` row of ``(max_len // block_size)`` physical block ids;
+    the attention read gathers the row's dense ``(H, max_len, head_dim)``
+    view through the table, so two rows whose tables share block ids share
+    the bytes — the `fork()` copy-on-write prefix-sharing substrate.
+
+    **Block 0 is the reserved zero block**: it backs every unallocated
+    table entry, is never allocated and never written (the write path
+    redirects any ``phys == 0`` target out of range and drops it), so an
+    unallocated position gathers exactly the zeros a freshly admitted
+    monolithic buffer holds there — the structural half of the paged ≡
+    monolithic bit-identity contract. ``mask`` and ``length`` stay dense
+    per-row (``(B, max_len)`` / ``(B,)``) exactly as in the vector-length
+    `KVCache`; only the key/value planes (and the quantized scale tables,
+    ``(num_blocks, H, block_size)``) are paged.
+    """
+
+    pool_key: Array  # (num_blocks, H, block_size, head_dim)
+    pool_value: Array
+    block_table: Array  # (B, max_len // block_size) int32; 0 = zero block
+    mask: Array  # (B, max_len) bool — dense, as in the monolithic cache
+    length: Array  # (B,) int32 per-row cursors
+    pool_key_scale: Optional[Array] = None  # (num_blocks, H, block_size) fp32
+    pool_value_scale: Optional[Array] = None
+
+    @property
+    def block_size(self) -> int:
+        return self.pool_key.shape[2]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.pool_key.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.block_table.shape[1] * self.pool_key.shape[2]
+
+    @classmethod
+    def init(
+        cls,
+        batch_size: int,
+        num_heads: int,
+        num_blocks: int,
+        block_size: int,
+        max_len: int,
+        head_dim: int,
+        dtype=jnp.float32,
+    ):
+        from ..ops.kv_quant import is_quantized_dtype
+
+        if max_len % block_size != 0:
+            raise ValueError(
+                f"paged cache needs block_size ({block_size}) to divide "
+                f"max_len ({max_len})"
+            )
+        quantized = is_quantized_dtype(dtype)
+
+        def scale():
+            # Ones, matching the monolithic scale-table init: the zero
+            # block then dequantizes to exactly 0.0 (0 * 1.0), the same
+            # bytes a zero-initialized monolithic buffer dequantizes to.
+            return (
+                jnp.ones((num_blocks, num_heads, block_size), jnp.float32)
+                if quantized
+                else None
+            )
+
+        return cls(
+            pool_key=jnp.zeros((num_blocks, num_heads, block_size, head_dim), dtype=dtype),
+            pool_value=jnp.zeros((num_blocks, num_heads, block_size, head_dim), dtype=dtype),
+            block_table=jnp.zeros((batch_size, max_len // block_size), jnp.int32),
+            mask=jnp.zeros((batch_size, max_len), dtype=bool),
+            length=jnp.zeros((batch_size,), jnp.int32),
+            pool_key_scale=scale(),
+            pool_value_scale=scale(),
+        )
+
+
+def paged_kv_bytes_per_block(
+    num_layers: int, num_heads: int, block_size: int, head_dim: int, cache_dtype, compute_dtype
+) -> int:
+    """HBM bytes one block pins across all layers (planes + scale rows)."""
+    from ..ops.kv_quant import is_quantized_dtype, resolve_cache_dtype
+
+    dtype, _ = resolve_cache_dtype(cache_dtype, compute_dtype)
+    plane = num_heads * block_size * head_dim * jnp.dtype(dtype).itemsize
+    scale = (
+        num_heads * block_size * jnp.dtype(jnp.float32).itemsize
+        if is_quantized_dtype(dtype)
+        else 0
+    )
+    return num_layers * 2 * (plane + scale)
+
+
+def init_paged_kv_caches(
+    config: StructuredTransformerConfig,
+    batch_size: int,
+    num_blocks: int,
+    block_size: int,
+    max_len: int | None = None,
+    cache_dtype: str | None = None,
+) -> tuple[PagedKVCache, ...]:
+    """Preallocates one `PagedKVCache` per hidden layer (engine paged mode)."""
+    if max_len is None:
+        max_len = config.max_seq_len
+    if cache_dtype is not None:
+        from ..ops.kv_quant import resolve_cache_dtype
+
+        dtype, _ = resolve_cache_dtype(cache_dtype, config.compute_dtype)
+    else:
+        dtype = config.compute_dtype
+    return tuple(
+        PagedKVCache.init(
+            batch_size,
+            config.num_attention_heads,
+            num_blocks,
+            block_size,
+            max_len,
+            config.head_dim,
+            dtype,
+        )
+        for _ in range(config.num_hidden_layers)
+    )
+
+
 def init_kv_caches(
     config: StructuredTransformerConfig,
     batch_size: int,
@@ -297,7 +429,102 @@ class InnerSelfAttention(nn.Module):
             query, key, value = heads_first(query), heads_first(key), heads_first(value)
 
         present = None
-        if layer_past is not None and getattr(layer_past.length, "ndim", 0) == 1:
+        if isinstance(layer_past, PagedKVCache):
+            # Paged block-pool cache (the serving engine's copy-on-write
+            # decode path): writes scatter each row's chunk into the
+            # physical block its table maps the cursor position to; the
+            # read gathers the row's dense view through the table and then
+            # runs EXACTLY the vector-length branch's position/mask math.
+            # Because every allocated block holds byte-identical content to
+            # the corresponding monolithic buffer span and every
+            # unallocated position gathers the zero block's zeros (the
+            # bytes monolithic admission leaves there), the dense view —
+            # and therefore the attention output — is bit-identical to the
+            # monolithic cache at every step.
+            bs_blk = layer_past.block_size
+            n_blocks = layer_past.num_blocks
+            T_blk = layer_past.block_table.shape[1]
+            max_len = T_blk * bs_blk
+            start = layer_past.length  # (B,)
+            pos = jnp.arange(max_len)
+            if S == 1:
+                write = pos[None, :] == start[:, None]  # (B, max_len)
+                gather_mask = lambda m: m  # (B, 1)  # noqa: E731
+            else:
+                # Speculative multi-event range write, preserved on the
+                # block path: same dense write mask / source gather as the
+                # monolithic S > 1 branch; the pool scatter below walks the
+                # S chunk positions with a static loop.
+                write = (pos[None, :] >= start[:, None]) & (
+                    pos[None, :] < start[:, None] + S
+                )
+                src = jnp.clip(pos[None, :] - start[:, None], 0, S - 1)
+                gather_mask = lambda m: jnp.take_along_axis(m, src, axis=1)  # noqa: E731
+            quantized = layer_past.pool_key_scale is not None
+            if quantized:
+                from ..ops.kv_quant import dequantize_kv, quantize_kv
+
+                k_chunk, k_s = quantize_kv(key, layer_past.pool_key.dtype)
+                v_chunk, v_s = quantize_kv(value, layer_past.pool_value.dtype)
+            else:
+                k_chunk = key.astype(layer_past.pool_key.dtype)
+                v_chunk = value.astype(layer_past.pool_value.dtype)
+                k_s = v_s = None
+            pk, pv = layer_past.pool_key, layer_past.pool_value
+            pks, pvs = layer_past.pool_key_scale, layer_past.pool_value_scale
+            for j in range(S):
+                pos_j = start + j  # (B,)
+                blk = jnp.clip(pos_j // bs_blk, 0, T_blk - 1)
+                off = pos_j % bs_blk
+                phys = jnp.take_along_axis(
+                    layer_past.block_table, blk[:, None], axis=1
+                )[:, 0]
+                # Write-drop rule: the zero block (phys == 0) is never a
+                # legitimate target — it backs unallocated entries (rows
+                # never admitted, positions past a row's allocation), so
+                # their writes redirect out of range and drop. Positions
+                # past the buffer drop too (the monolithic one-hot write
+                # matches nothing there).
+                phys = jnp.where((phys == 0) | (pos_j >= max_len), n_blocks, phys)
+                pk = pk.at[phys, :, off, :].set(k_chunk[:, :, j, :], mode="drop")
+                pv = pv.at[phys, :, off, :].set(v_chunk[:, :, j, :], mode="drop")
+                if quantized:
+                    pks = pks.at[phys, :, off].set(k_s[:, :, j], mode="drop")
+                    pvs = pvs.at[phys, :, off].set(v_s[:, :, j], mode="drop")
+            chunk_mask = (
+                attention_mask if attention_mask is not None else jnp.ones((B, S), dtype=bool)
+            )
+            new_mask = jnp.where(write, gather_mask(chunk_mask), layer_past.mask)
+
+            def gather_pool(pool):  # (N, H, bs, D) -> (B, H, max_len, D)
+                g = pool[layer_past.block_table]  # (B, T, H, bs, D)
+                g = g.swapaxes(1, 2)  # (B, H, T, bs, D)
+                return g.reshape(g.shape[0], g.shape[1], max_len, *g.shape[4:])
+
+            new_key = gather_pool(pk)
+            new_value = gather_pool(pv)
+            if use_cache:
+                present = PagedKVCache(
+                    pool_key=pk,
+                    pool_value=pv,
+                    block_table=layer_past.block_table,
+                    mask=new_mask,
+                    length=start + S,
+                    pool_key_scale=pks,
+                    pool_value_scale=pvs,
+                )
+            if quantized:
+                key = dequantize_kv(new_key, gather_pool(pks), dt)
+                value = dequantize_kv(new_value, gather_pool(pvs), dt)
+            else:
+                key, value = new_key, new_value
+            k_positions = pos
+            q_positions = start[:, None] + jnp.arange(q_len)[None, :] + (
+                1 if static_kv_first else 0
+            )
+            valid_k = pos[None, :] < (start[:, None] + S)
+            attention_mask = new_mask
+        elif layer_past is not None and getattr(layer_past.length, "ndim", 0) == 1:
             # Per-row cache cursors (the serving engine's decode slots): each
             # row writes its ``S`` new keys/values starting at its own
             # ``length[b]``. S == 1 is the decode hot loop (one-hot select,

@@ -89,14 +89,18 @@ from ..models.model_output import GenerativeSequenceModelPredictions
 from ..models.transformer import (
     KVCache,
     NAPast,
+    PagedKVCache,
     init_kv_caches,
+    init_paged_kv_caches,
     mask_batch_to_levels,
     na_level_of_measurement,
+    paged_kv_bytes_per_block,
     time_from_deltas,
 )
 from ..ops.tensor_ops import take_event
 from .scheduler import (
     EngineResult,
+    ForkSpec,
     Request,
     Scheduler,
     check_prompt_finite,
@@ -120,6 +124,95 @@ _CORE_FIELDS = (
     "dynamic_values_mask",
     "start_time",
 )
+
+
+class BlockAllocator:
+    """Host-side reference-counted free list over the device block pool.
+
+    The pool itself is a device array (`PagedKVCache.pool_*`); this class
+    owns WHICH physical blocks are free, shared, or exclusively held — all
+    plain Python, never traced. Block 0 is the reserved zero block: it is
+    never allocated, every unused block-table entry points at it, and the
+    attention gather reads its all-zero bytes for unwritten positions (the
+    structural half of the paged == monolithic bit-identity argument).
+
+    Freeing is DEFERRED: a slot's blocks are released when the slot is
+    re-admitted (or at `reset()`), not when its request is harvested. Done
+    rows keep executing decode writes at their frozen cursor (the step
+    merges discard the results, but the pool scatters still land), so a
+    block must stay held by its row until no further dispatch can touch
+    it. The default pool (`n_slots * blocks_per_slot + 1`) makes deferred
+    freeing safe by construction: every slot can hold a full table at once.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # Popped from the tail: blocks allocate in ascending order, which
+        # keeps admissions deterministic given a deterministic free order.
+        self._free: list[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._rc = np.zeros(self.num_blocks, np.int32)
+        # Lifetime counters — survive reset_occupancy() (engine.reset()),
+        # per the padding_report contract.
+        self.high_water = 0
+        self.frag_events = 0
+        self.cover_events = 0
+        self.allocs_total = 0
+        self.frees_total = 0
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.num_blocks - 1 - len(self._free)
+
+    def shared_blocks(self) -> int:
+        """Blocks currently held by more than one block table (CoW prefix)."""
+        return int((self._rc >= 2).sum())
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"block pool exhausted: need {n} blocks, {len(self._free)} free "
+                f"of {self.num_blocks - 1} usable (size the pool with "
+                "num_blocks >= n_slots * (max_len // block_size) + 1 for "
+                "worst-case occupancy)"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._rc[b] = 1
+        self.allocs_total += n
+        self.high_water = max(self.high_water, self.in_use)
+        return out
+
+    def incref(self, blocks, n: int = 1) -> None:
+        for b in blocks:
+            self._rc[b] += n
+
+    def decref(self, blocks) -> int:
+        freed = 0
+        for b in blocks:
+            self._rc[b] -= 1
+            if self._rc[b] == 0:
+                self._free.append(b)
+                freed += 1
+        self.frees_total += freed
+        return freed
+
+    def note_cover(self, cover_events: int, allocated_blocks: int) -> None:
+        """Accumulates internal-fragmentation accounting for one admission."""
+        self.cover_events += int(cover_events)
+        self.frag_events += int(
+            allocated_blocks * self.block_size - cover_events
+        )
+
+    def reset_occupancy(self) -> None:
+        """Returns every block to the free list (engine.reset()), KEEPING
+        the lifetime high-water/fragmentation counters."""
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._rc[:] = 0
 
 
 @struct.dataclass
@@ -347,6 +440,9 @@ class GenerationEngine:
         top_k: int | None = None,
         top_p: float | None = None,
         kv_cache_dtype: str | None = None,
+        paged_kv: bool = False,
+        block_size: int = 16,
+        num_blocks: int | None = None,
         spec: Optional[SpecConfig] = None,
         greedy: bool = False,
         health_sentinel: bool = True,
@@ -517,11 +613,76 @@ class GenerationEngine:
                 # design). Raises loudly on split-mode levels.
                 self._na_level_of_meas = na_level_of_measurement(config)
 
+        # Paged copy-on-write KV cache: the per-slot monolithic seq caches
+        # become one refcounted block pool + per-slot block tables, making
+        # shared prefixes (fork()) free. Composition matrix (docs/serving.md
+        # "Paged KV cache and branched rollouts"): kvq composes (the scale
+        # tables page alongside the planes); spec / tensor-parallel / NA /
+        # the dedicated prefill stream do not yet — each is a loud error.
+        self.paged_kv = bool(paged_kv)
+        self.block_size = int(block_size)
+        self._block_alloc: Optional[BlockAllocator] = None
+        self._tables: Optional[np.ndarray] = None
+        self._paged_num_blocks = 0
+        self._next_fork_group = 0
+        if self.paged_kv:
+            if self._is_na:
+                raise ValueError(
+                    "paged KV cache does not support nested-attention models "
+                    "yet: the dep-graph caches reset per event and do not "
+                    "page; run NA engines with paged_kv=False"
+                )
+            if spec is not None:
+                raise ValueError(
+                    "paged KV cache does not compose with speculative decoding "
+                    "yet: the verify window re-reads freshly written positions "
+                    "through the draft/target cache pair, which still admits "
+                    "monolithically; drop spec or paged_kv"
+                )
+            if self.tensor_parallel:
+                raise ValueError(
+                    "paged KV cache on tensor-parallel serve meshes is not "
+                    "supported: the block pool replicates over the mesh, which "
+                    "would defeat the model-axis KV sharding; shard slots over "
+                    "'data' only"
+                )
+            if self.block_size < 1 or self.max_len % self.block_size != 0:
+                raise ValueError(
+                    f"block_size ({self.block_size}) must divide max_len "
+                    f"({self.max_len}) — block tables cover the slot width "
+                    "exactly"
+                )
+            blocks_per_slot = self.max_len // self.block_size
+            if num_blocks is None:
+                # Worst case: every slot holds a full table, + the zero block.
+                num_blocks = self.n_slots * blocks_per_slot + 1
+            num_blocks = int(num_blocks)
+            if num_blocks < blocks_per_slot + 1:
+                raise ValueError(
+                    f"num_blocks ({num_blocks}) must fit at least one full "
+                    f"slot table ({blocks_per_slot}) plus the zero block"
+                )
+            if num_blocks == self.n_slots:
+                # `_tree_shardings` replicates any leaf whose leading dim is
+                # not n_slots; a pool that HAPPENS to match n_slots would be
+                # row-sharded by accident. One spare block breaks the tie.
+                num_blocks += 1
+            self._paged_num_blocks = num_blocks
+            self._block_alloc = BlockAllocator(num_blocks, self.block_size)
+            # Host mirror of the device block tables (0 = zero block): block
+            # planning, deferred freeing, and slots_report sharing stats all
+            # read this — the device tables are never copied back.
+            self._tables = np.zeros((self.n_slots, blocks_per_slot), np.int32)
+        elif num_blocks is not None:
+            raise ValueError("num_blocks requires paged_kv=True")
+
         self.scheduler = Scheduler(
             self.n_slots,
             make_buckets(min_bucket, self.max_prompt_len),
             max_pending=max_queue,
         )
+        if self.paged_kv:
+            self.scheduler.block_pool_stats = self._block_pool_stats
 
         self._template = self._normalize_prompt(template)
         self._state = self._init_state()
@@ -603,6 +764,8 @@ class GenerationEngine:
                 donate_argnums=(1, 2),
             )
         self._prefill_jits: dict[tuple[int, int], Any] = {}
+        self._prefill_fork_fwd_jits: dict[int, Any] = {}
+        self._prefill_fork_admit_jits: dict[int, Any] = {}
         self._prefill_spec_jits: dict[tuple[int, int], Any] = {}
         # Prefill-stream split programs: the bucketed prefill forward with no
         # slot scatter (runs on a dedicated prefill replica) and the admit
@@ -700,12 +863,24 @@ class GenerationEngine:
             dynamic_values_mask=rows(t.dynamic_values_mask, True),
             start_time=rows(t.start_time, False),
         )
-        seq_caches = tuple(
-            kv.replace(length=jnp.zeros((S,), jnp.int32))
-            for kv in init_kv_caches(
-                self.config, S, max_len=L, cache_dtype=self.kv_cache_dtype
+        if self.paged_kv:
+            seq_caches = tuple(
+                init_paged_kv_caches(
+                    self.config,
+                    S,
+                    self._paged_num_blocks,
+                    self.block_size,
+                    max_len=L,
+                    cache_dtype=self.kv_cache_dtype,
+                )
             )
-        )
+        else:
+            seq_caches = tuple(
+                kv.replace(length=jnp.zeros((S,), jnp.int32))
+                for kv in init_kv_caches(
+                    self.config, S, max_len=L, cache_dtype=self.kv_cache_dtype
+                )
+            )
         if self._is_na:
             n_levels = len(self._measurements_to_fill_list)
             max_dep_len = len(self.config.measurements_per_dep_graph_level) + 1
@@ -892,6 +1067,28 @@ class GenerationEngine:
         return done | hit, health | hit
 
     def _merge_caches(self, active, new, old):
+        if self.paged_kv:
+            # Pool planes take NEW unconditionally: inactive rows' decode
+            # writes land in their own exclusively held blocks at frozen
+            # cursors (the allocator defers freeing until re-admission), so
+            # the bytes they touch are never read by a live row — and the
+            # attention softmax zeroes masked weights exactly (MASK_VALUE
+            # underflows exp in fp32), so even the written bytes cannot
+            # reach any output. Per-row state merges with where(active).
+            return tuple(
+                PagedKVCache(
+                    pool_key=n.pool_key,
+                    pool_value=n.pool_value,
+                    block_table=jnp.where(
+                        active[:, None], n.block_table, o.block_table
+                    ),
+                    mask=jnp.where(active[:, None], n.mask, o.mask),
+                    length=jnp.where(active, n.length, o.length),
+                    pool_key_scale=n.pool_key_scale,
+                    pool_value_scale=n.pool_value_scale,
+                )
+                for n, o in zip(new, old)
+            )
         if self._is_na:
             seq = self._merge_rows(active, new.seq_past, old.seq_past)
             # Dep-graph caches advance in lockstep (reset every event, shared
@@ -1591,13 +1788,35 @@ class GenerationEngine:
     def _prefill_jit(self, bucket_len: int, group: int):
         key = (bucket_len, group)
         if key not in self._prefill_jits:
-            fn = functools.partial(
-                self._prefill_na if self._is_na else self._prefill_ci, bucket_len
-            )
+            if self.paged_kv:
+                fn = functools.partial(self._prefill_paged, bucket_len)
+            else:
+                fn = functools.partial(
+                    self._prefill_na if self._is_na else self._prefill_ci,
+                    bucket_len,
+                )
             self._prefill_jits[key] = jax.jit(
                 fn, donate_argnums=(1,), out_shardings=self._state_out_shardings
             )
         return self._prefill_jits[key]
+
+    def _prefill_fork_fwd_jit(self, bucket_len: int):
+        """Fork stage 1 (paged engines): the batch-1 shared-prompt forward,
+        materialized at a program boundary (see `_prefill_fork_fwd`)."""
+        if bucket_len not in self._prefill_fork_fwd_jits:
+            fn = functools.partial(self._prefill_fork_fwd, bucket_len)
+            self._prefill_fork_fwd_jits[bucket_len] = jax.jit(fn)
+        return self._prefill_fork_fwd_jits[bucket_len]
+
+    def _prefill_fork_admit_jit(self, group: int):
+        """Fork stage 2 (paged engines): tile the materialized prefill to g
+        branches, sample each branch's first event, CoW admit."""
+        if group not in self._prefill_fork_admit_jits:
+            fn = functools.partial(self._prefill_fork_admit, group)
+            self._prefill_fork_admit_jits[group] = jax.jit(
+                fn, donate_argnums=(0,), out_shardings=self._state_out_shardings
+            )
+        return self._prefill_fork_admit_jits[group]
 
     def _prefill_compute_jit(self, bucket_len: int, group: int):
         """The prefill forward WITHOUT the slot scatter — the program a
@@ -1654,6 +1873,76 @@ class GenerationEngine:
         )
         return self._admit(
             state, big1, caches1, plen, budgets, keys1, slots, first_event_real=fer
+        )
+
+    def _prefill_paged(
+        self, Lb, params, state, pbig, plen, budgets, keys, slots,
+        read_table, scatter_table,
+    ):
+        """The paged-engine prefill program: the SAME bucketed forward +
+        first-event sample as the monolithic path (`_prefill_forward_ci` —
+        prefill itself always runs on small monolithic caches), admitted
+        through the block-pool scatter instead of the row scatter."""
+        big1, caches1, keys1, fer = self._prefill_forward_ci(
+            Lb, params, pbig, plen, keys
+        )
+        src_rows = jnp.arange(plen.shape[0], dtype=jnp.int32)
+        return self._admit(
+            state, big1, caches1, plen, budgets, keys1, slots,
+            first_event_real=fer,
+            paged_tables=(read_table, scatter_table, src_rows),
+        )
+
+    def _prefill_fork_fwd(self, Lb, params, prow, plen1):
+        """ONE batch-1 prefill forward of a fork group's shared prompt,
+        MATERIALIZED at a program boundary. The split is load-bearing for
+        bitwise parity with independent submissions: sampling fused into a
+        batch-1-forward+tile program compiles a (1-ulp) different tail than
+        the fused batch-g prefill, whereas sampling over materialized
+        arrays is bitwise identical to the fused batch-g program (pinned by
+        test) — so the fork pipeline is forward here, tile + sample + admit
+        in `_prefill_fork_admit`."""
+        view = prow.slice((slice(None), slice(0, Lb)))
+        out = self.model.apply(
+            params,
+            view,
+            past=init_kv_caches(self.config, 1, max_len=self.max_len),
+            use_cache=True,
+            is_generation=True,
+        )
+        preds1 = _slice_preds_at(out.preds, plen1 - 1)
+        em1 = take_event(prow.event_mask, plen1 - 1)
+        return out.past_key_values, preds1, em1
+
+    def _prefill_fork_admit(
+        self, g, state, prow, caches1, preds1, em1, plen, budgets, keys,
+        slots, read_table, scatter_table,
+    ):
+        """Tiles the materialized batch-1 prefill to ``g`` branch rows,
+        samples each branch's first event on its own key
+        (``fold_in(session_key, branch_index)``), and admits the group
+        copy-on-write: branch 0's scatter_table writes the shared prefix
+        blocks (+ its own tail); branches > 0 write only their private
+        tails; src_rows all point at the single prefilled cache row
+        (`_scatter_kv_paged`). Row-wise identical to the fused batch-g
+        prefill of g copies of the prompt — the fork == independent
+        bit-identity contract."""
+
+        def tile(x):
+            return jnp.concatenate([x] * g, axis=0)
+
+        big = jax.tree_util.tree_map(tile, prow)
+        new_keys, step_keys = _vmap_split(keys)
+        preds_g = jax.tree_util.tree_map(tile, preds1)
+        em_g = tile(em1)
+        sample = self._sample_rows(preds_g, em_g, step_keys)
+        big1 = append_new_event(big, sample, self.config, plen)
+        big1 = update_last_event_data(big1, sample, self.config, plen + 1)
+        src_rows = jnp.zeros((g,), jnp.int32)
+        return self._admit(
+            state, big1, caches1, plen, budgets, new_keys, slots,
+            first_event_real=sample.event_mask,
+            paged_tables=(read_table, scatter_table, src_rows),
         )
 
     def _prefill_na(self, Lb, params, state, pbig, plen, budgets, keys, slots):
@@ -1777,7 +2066,70 @@ class GenerationEngine:
             self._scatter_kv(d, s, True, slots, plen) for d, s in zip(dst, src)
         )
 
-    def _admit(self, state, big1, caches1, plen, budgets, keys1, slots, first_event_real):
+    def _scatter_kv_paged(
+        self, dst: PagedKVCache, src: KVCache, slots, plen,
+        read_table, scatter_table, src_rows,
+    ) -> PagedKVCache:
+        """One prefilled (monolithic, full-``max_len``) cache admitted into
+        the block pool. ``read_table``/``scatter_table`` are ``(g, T)``
+        physical-block tables: `read_table` is what the row's attention
+        gather will see (shared CoW prefix + private tail); `scatter_table`
+        is what THIS row's admit writes — fork branches > 0 carry 0 for the
+        shared prefix entries (redirected to the drop index) so each shared
+        block is written exactly once, by branch 0, from the identical
+        batch-1 prefill bytes. ``src_rows`` maps group row -> source cache
+        row (identity normally; all-zeros for a fork's batch-1 source).
+
+        Bit-identity vs the monolithic admit: the prefill forward runs on
+        full-width monolithic caches, so ``src`` carries the same bytes the
+        monolithic path scatters — prompt rows, bucket-pad rows, and zeros
+        past the bucket. Every position covered by an allocated block gets
+        those bytes; positions beyond the table's coverage gather the zero
+        block's zeros, which is byte-equal to the monolithic buffer's
+        untouched zeros. The dense gathered view is therefore equal to the
+        monolithic buffer at EVERY position."""
+        bs = self.block_size
+        T = self.max_len // bs
+        N = self._paged_num_blocks
+        if dst.pool_key_scale is not None:
+            from ..ops.kv_quant import quantize_kv
+
+            k_src, k_s = quantize_kv(src.key, dst.pool_key.dtype)
+            v_src, v_s = quantize_kv(src.value, dst.pool_value.dtype)
+        else:
+            k_src = src.key.astype(dst.pool_key.dtype)
+            v_src = src.value.astype(dst.pool_value.dtype)
+            k_s = v_s = None
+        pk, pv = dst.pool_key, dst.pool_value
+        pks, pvs = dst.pool_key_scale, dst.pool_value_scale
+        for j in range(T):
+            phys = scatter_table[:, j]
+            phys = jnp.where(phys == 0, N, phys)  # zero block: never written
+            kb = k_src[src_rows, :, j * bs : (j + 1) * bs, :]
+            vb = v_src[src_rows, :, j * bs : (j + 1) * bs, :]
+            pk = pk.at[phys].set(kb, mode="drop")
+            pv = pv.at[phys].set(vb, mode="drop")
+            if pks is not None:
+                pks = pks.at[phys].set(
+                    k_s[src_rows, :, j * bs : (j + 1) * bs], mode="drop"
+                )
+                pvs = pvs.at[phys].set(
+                    v_s[src_rows, :, j * bs : (j + 1) * bs], mode="drop"
+                )
+        return PagedKVCache(
+            pool_key=pk,
+            pool_value=pv,
+            block_table=dst.block_table.at[slots].set(read_table, mode="drop"),
+            mask=dst.mask.at[slots].set(src.mask[src_rows], mode="drop"),
+            length=dst.length.at[slots].set(plen, mode="drop"),
+            pool_key_scale=pks,
+            pool_value_scale=pvs,
+        )
+
+    def _admit(
+        self, state, big1, caches1, plen, budgets, keys1, slots, first_event_real,
+        paged_tables=None,
+    ):
         """Scatters prefilled rows into the slot state. ``slots`` may carry
         out-of-range indices for inert padded group rows (dropped).
 
@@ -1786,7 +2138,11 @@ class GenerationEngine:
         bucket-padding hole, cache positions stay contiguous with
         ``generate()``'s, and position-based masking (the sliding-window
         rule `k > q - window`) sees exactly the history generate() would —
-        holes never consume window slots."""
+        holes never consume window slots.
+
+        ``paged_tables`` (paged engines only) is the
+        ``(read_table, scatter_table, src_rows)`` triple the block-pool
+        admit consumes (`_scatter_kv_paged`)."""
         cursor1 = plen + 1
 
         def scatter(dst, src):
@@ -1796,7 +2152,16 @@ class GenerationEngine:
             return jax.tree_util.tree_map(f, dst, src)
 
         big = scatter(state.big, big1)
-        caches = self._scatter_caches(state.caches, caches1, slots, plen)
+        if paged_tables is not None:
+            read_table, scatter_table, src_rows = paged_tables
+            caches = tuple(
+                self._scatter_kv_paged(
+                    d, s, slots, plen, read_table, scatter_table, src_rows
+                )
+                for d, s in zip(state.caches, caches1)
+            )
+        else:
+            caches = self._scatter_caches(state.caches, caches1, slots, plen)
 
         n_gen1 = first_event_real.astype(jnp.int32)
         done1 = self._row_done(big1, cursor1, plen, n_gen1, budgets)
@@ -2093,6 +2458,18 @@ class GenerationEngine:
     def _request_key(self, req: Request) -> jnp.ndarray:
         if req.key is not None:
             return _as_raw_key(req.key)
+        if req.fork is not None:
+            # The fork key-derivation contract (docs/serving.md): branch j
+            # draws from fold_in(session_key, j), where the session key is
+            # the caller's explicit key or — unkeyed — the engine key folded
+            # with branch 0's admission index. Bitwise equal to submitting
+            # the j-th branch independently with that explicit key.
+            session = req.fork.session_key
+            if session is None:
+                session = derive_request_key(
+                    self._base_key, req.fork.session_admission_index
+                )
+            return derive_request_key(session, req.branch_index)
         return derive_request_key(self._base_key, req.admission_index)
 
     def _group_arrays(self, requests: list, g: int):
@@ -2119,10 +2496,119 @@ class GenerationEngine:
         )
         return stacked, plen, budgets, keys
 
+    def _free_slot_blocks(self, slot: int) -> None:
+        """Releases the blocks the slot's PREVIOUS tenant held (deferred
+        freeing — see `BlockAllocator`). Called at re-admission and reset."""
+        row = self._tables[slot]
+        held = [int(b) for b in row if b != 0]
+        if held:
+            self._block_alloc.decref(held)
+        row[:] = 0
+
+    def _plan_admission_tables(self, group) -> tuple[np.ndarray, np.ndarray]:
+        """Host-side block planning for one admission group: frees the
+        target slots' previous blocks, allocates coverage for each row's
+        ``prompt + budget`` events, and returns the ``(read, scatter)``
+        table pair the paged admit consumes. Fork groups allocate the
+        shared full-prompt blocks ONCE (refcount = n_branches) and only the
+        partial prompt block + generation tail per branch — the CoW layout:
+        decode's first write lands at position ``plen >= n_full * bs``, so
+        shared blocks are frozen for their whole refcounted lifetime."""
+        g = group.group_size
+        bs = self.block_size
+        T = self.max_len // bs
+        alloc = self._block_alloc
+        read = np.zeros((g, T), np.int32)
+        scat = np.zeros((g, T), np.int32)
+        covers = [
+            min(r.prompt_len + r.max_new_events, self.max_len)
+            for r in group.requests
+        ]
+        blocks_per_row = [-(-c // bs) for c in covers]
+        for s in group.slots:
+            self._free_slot_blocks(s)
+        n_full = 0
+        if group.fork is not None:
+            n_full = group.requests[0].prompt_len // bs
+        need = sum(blocks_per_row) - n_full * max(len(group.requests) - 1, 0)
+        if need > alloc.free_blocks:
+            raise RuntimeError(
+                f"block pool exhausted planning an admission: need {need} "
+                f"blocks, {alloc.free_blocks} free of {alloc.num_blocks - 1} "
+                "usable (size the pool with num_blocks >= n_slots * "
+                "(max_len // block_size) + 1 for worst-case occupancy)"
+            )
+        if group.fork is None:
+            for i, (s, cover, n) in enumerate(
+                zip(group.slots, covers, blocks_per_row)
+            ):
+                blocks = alloc.alloc(n)
+                read[i, :n] = blocks
+                scat[i, :n] = blocks
+                self._tables[s, :] = read[i]
+                alloc.note_cover(cover, n)
+            return read, scat
+        shared = alloc.alloc(n_full)
+        if len(group.requests) > 1:
+            alloc.incref(shared, len(group.requests) - 1)
+        for i, (s, cover, n) in enumerate(
+            zip(group.slots, covers, blocks_per_row)
+        ):
+            priv = alloc.alloc(n - n_full)
+            read[i, :n_full] = shared
+            read[i, n_full:n] = priv
+            if i == 0:
+                scat[i, :n] = read[i, :n]
+            else:
+                # Branches > 0 never write the shared prefix: each shared
+                # block is admitted exactly once, by branch 0, from the
+                # single prefilled source row.
+                scat[i, n_full:n] = priv
+            self._tables[s, :] = read[i]
+            alloc.note_cover(cover, n)
+        return read, scat
+
     def _dispatch_group(self, group) -> None:
         n, g = len(group.requests), group.group_size
-        stacked, plen, budgets, keys = self._group_arrays(group.requests, g)
         slots = jnp.asarray(group.slots + [self.n_slots] * (g - n), jnp.int32)
+        if self.paged_kv:
+            read_np, scat_np = self._plan_admission_tables(group)
+            read_t = jnp.asarray(read_np)
+            scat_t = jnp.asarray(scat_np)
+            if group.fork is not None:
+                r0 = group.requests[0]
+                prow = self._pad_prompt_row(r0.prompt)
+                plen = jnp.full((g,), r0.prompt_len, jnp.int32)
+                budgets = jnp.asarray(
+                    [r.max_new_events for r in group.requests]
+                    + [1] * (g - n),
+                    jnp.int32,
+                )
+                keys = jnp.stack(
+                    [self._request_key(r) for r in group.requests]
+                    + [jnp.zeros((2,), jnp.uint32)] * (g - n)
+                )
+                plen1 = jnp.full((1,), r0.prompt_len, jnp.int32)
+                caches1, preds1, em1 = self._prefill_fork_fwd_jit(
+                    group.bucket_len
+                )(self.params, prow, plen1)
+                self._state = self._prefill_fork_admit_jit(g)(
+                    self._state, prow, caches1, preds1, em1, plen, budgets,
+                    keys, slots, read_t, scat_t,
+                )
+            else:
+                stacked, plen, budgets, keys = self._group_arrays(
+                    group.requests, g
+                )
+                self._state = self._prefill_jit(group.bucket_len, g)(
+                    self.params, self._state, stacked, plen, budgets, keys,
+                    slots, read_t, scat_t,
+                )
+            for r, s in zip(group.requests, group.slots):
+                self._table[s] = r
+                self._slot_epoch[s] = self._dispatched_chunks
+            return
+        stacked, plen, budgets, keys = self._group_arrays(group.requests, g)
         if self.spec is not None:
             self._state, self._spec_state = self._prefill_spec_jit(
                 group.bucket_len, g
@@ -2167,6 +2653,13 @@ class GenerationEngine:
                 "rows (and the stream replica the draft weights); use the "
                 "budget-capped local prefill path (prefill_budget_events)"
             )
+        if self.paged_kv:
+            raise NotImplementedError(
+                "paged engines do not serve behind a dedicated prefill "
+                "stream yet: the handoff admit would need the decode "
+                "replica's block tables planned at compute time; prefill "
+                "locally (the paged admit is a block scatter either way)"
+            )
         for r in requests:
             if r.key is None:
                 raise ValueError(
@@ -2195,6 +2688,11 @@ class GenerationEngine:
         only work the decode replica pays for an admission when a dedicated
         prefill tier runs (the full prefill forward happened on the prefill
         replica's dispatch stream)."""
+        if self.paged_kv:
+            raise NotImplementedError(
+                "paged engines do not take prefill-stream handoffs "
+                "(see prefill_compute)"
+            )
         n, g = len(handoff.requests), handoff.group
         if len(slots) != n:
             raise ValueError(f"{n} handoff rows need {n} slots, got {len(slots)}")
@@ -2382,6 +2880,97 @@ class GenerationEngine:
                     "would poison its decode slot)"
                 )
         return self.scheduler.submit(request)
+
+    def fork(
+        self,
+        prompt: EventStreamBatch,
+        n_branches: int,
+        max_new_events: int,
+        *,
+        key=None,
+        request_id=None,
+        request_ids=None,
+        arrival_time: float = 0.0,
+    ) -> list[Request]:
+        """Submits one shared prompt as ``n_branches`` copy-on-write
+        branches: ONE prefill forward lands the shared history in frozen
+        refcounted blocks; each branch holds only its partial prompt block
+        + generation tail privately, and draws from
+        ``fold_in(session_key, branch_index)`` — results are bitwise
+        identical to ``n_branches`` independent submissions of the same
+        prompt with those explicit keys, at 1/n_branches of the prefill
+        compute and ~1/n_branches of the prefix HBM.
+
+        ``key`` (optional) is the session key; without it the session key
+        is ``fold_in(engine_key, branch-0 admission index)``, exactly what
+        an independent submission of branch 0 would have bound.
+        ``request_id`` (optional) stamps branch results as
+        ``(request_id, branch_index)``; ``request_ids`` (optional,
+        exclusive with ``request_id``) gives each branch its caller id
+        directly — the service tier routes results by its own admission
+        indices this way. The fork group admits atomically (all branches
+        in one prefill dispatch, strict FIFO)."""
+        if not self.paged_kv:
+            raise ValueError(
+                "fork() needs the paged KV cache (paged_kv=True): branched "
+                "rollouts share prefix blocks copy-on-write, which the "
+                "monolithic per-slot cache cannot express"
+            )
+        n_branches = int(n_branches)
+        if n_branches < 1:
+            raise ValueError("n_branches must be >= 1")
+        if request_ids is not None:
+            if request_id is not None:
+                raise ValueError("pass request_id or request_ids, not both")
+            if len(request_ids) != n_branches:
+                raise ValueError(
+                    f"request_ids has {len(request_ids)} entries for "
+                    f"{n_branches} branches"
+                )
+        if n_branches > self.n_slots:
+            raise ValueError(
+                f"a fork group admits atomically: n_branches ({n_branches}) "
+                f"cannot exceed n_slots ({self.n_slots})"
+            )
+        sched = self.scheduler
+        if (
+            sched.max_pending is not None
+            and len(sched.queue) + n_branches > sched.max_pending
+        ):
+            from .scheduler import AdmissionRejected
+
+            sched._rejected += 1
+            raise AdmissionRejected(
+                f"admission queue cannot hold a {n_branches}-branch fork "
+                f"group ({len(sched.queue)}/{sched.max_pending}); rejecting "
+                "the whole group (branches admit atomically)"
+            )
+        spec = ForkSpec(
+            group_id=self._next_fork_group,
+            n_branches=n_branches,
+            session_key=None if key is None else _as_raw_key(key),
+        )
+        self._next_fork_group += 1
+        out = []
+        for j in range(n_branches):
+            if request_ids is not None:
+                rid = request_ids[j]
+            else:
+                rid = None if request_id is None else (request_id, j)
+            r = Request(
+                prompt=prompt,
+                max_new_events=max_new_events,
+                key=None,
+                request_id=rid,
+                arrival_time=arrival_time,
+                fork=spec,
+                branch_index=j,
+            )
+            if out:
+                # Branch 0's door validation covered the shared prompt.
+                r.prompt_validated = True
+            out.append(self.submit(r))
+        return out
 
     @property
     def occupied(self) -> int:
@@ -2711,14 +3300,97 @@ class GenerationEngine:
             group_sizes=self.scheduler.group_sizes,
             max_pending=self.scheduler.max_pending,
         )
+        if self.paged_kv:
+            # All occupancy returns to the pool; the lifetime high-water and
+            # fragmentation counters deliberately survive (padding_report
+            # contract), as does the fork-group id sequence.
+            self._block_alloc.reset_occupancy()
+            self._tables[:] = 0
+            self.scheduler.block_pool_stats = self._block_pool_stats
 
     # ---------------------------------------------------------- accounting
+    def _block_pool_stats(self) -> dict:
+        """The block-pool counters `Scheduler.padding_report` merges in
+        (installed as ``scheduler.block_pool_stats`` — on the scheduler
+        each `reset()` builds, so high-water/fragmentation survive reset
+        by living on the allocator, not the scheduler)."""
+        a = self._block_alloc
+        return {
+            "block_pool_num_blocks": a.num_blocks,
+            "block_pool_block_size": a.block_size,
+            "block_pool_in_use": a.in_use,
+            "block_pool_free": a.free_blocks,
+            "block_pool_high_water": a.high_water,
+            "block_pool_utilization": round(
+                a.in_use / max(a.num_blocks - 1, 1), 4
+            ),
+            "block_pool_shared_blocks": a.shared_blocks(),
+            "block_pool_frag_events": a.frag_events,
+            "block_pool_frag_frac": round(
+                a.frag_events / max(a.frag_events + a.cover_events, 1), 4
+            ),
+            "block_pool_allocs_total": a.allocs_total,
+            "block_pool_frees_total": a.frees_total,
+        }
+
+    def _paged_report(self, branch_factor: int = 1) -> dict:
+        """Block-granular capacity accounting for the paged engine.
+
+        ``effective_slots`` is MEASURED from the resident block tables:
+        usable pool blocks divided by the mean unique-block footprint per
+        resident row — with B branches sharing a long prefix, each row's
+        footprint shrinks toward ``prefix_blocks / B`` and effective slots
+        grow toward B x the monolithic count.
+        ``effective_slots_at_branch_factor`` is the analytic figure for a
+        hypothetical prefix-dominated workload at ``branch_factor``."""
+        cfg = self.config
+        a = self._block_alloc
+        T = self.max_len // self.block_size
+        usable = a.num_blocks - 1
+        bpb = paged_kv_bytes_per_block(
+            cfg.num_hidden_layers,
+            cfg.num_attention_heads,
+            self.block_size,
+            cfg.head_dim,
+            self.kv_cache_dtype,
+            cfg.compute_dtype,
+        )
+        resident_rows = int((self._tables != 0).any(axis=1).sum())
+        logical_blocks = int((self._tables != 0).sum())
+        unique_blocks = a.in_use
+        sharing = logical_blocks / max(unique_blocks, 1)
+        if resident_rows:
+            per_row_unique = unique_blocks / resident_rows
+            effective = usable / max(per_row_unique, 1e-9)
+        else:
+            effective = float(usable) / max(T, 1) * 1.0
+        B = max(int(branch_factor), 1)
+        # Prefix-dominated analytic bound: a full-table tenant whose prompt
+        # prefix (all but one block) is shared B ways.
+        per_branch = (T - 1) / B + 1
+        return {
+            "block_size": self.block_size,
+            "num_blocks": a.num_blocks,
+            "blocks_per_slot": T,
+            "bytes_per_block": bpb,
+            "pool_bytes": usable * bpb,
+            "blocks_in_use": unique_blocks,
+            "pool_utilization": round(unique_blocks / max(usable, 1), 4),
+            "high_water": a.high_water,
+            "resident_rows": resident_rows,
+            "sharing_ratio": round(sharing, 3),
+            "effective_slots": round(effective, 2),
+            "effective_slots_at_branch_factor": round(usable / per_branch, 2),
+            "branch_factor": B,
+        }
+
     def slots_report(
         self,
         hbm_gb: float = 16.0,
         config=None,
         max_len: int | None = None,
         params_bytes: int | None = None,
+        branch_factor: int = 1,
     ) -> dict:
         """Per-cache-dtype HBM capacity accounting (no allocation).
 
@@ -2739,6 +3411,11 @@ class GenerationEngine:
         by the ``max_len`` ratio (content rows grow with sequence capacity,
         not hidden width) — an estimate, but one that errs alongside the
         dominant KV term instead of ignoring the override.
+
+        Paged engines add a ``paged`` sub-dict (`_paged_report`):
+        bytes/block, pool utilization + high-water, the measured
+        block-sharing ratio over resident tables, and ``effective_slots``
+        (measured, plus the analytic figure at ``branch_factor``).
         """
         from ..ops.kv_quant import (
             CACHE_DTYPES,
@@ -2814,7 +3491,14 @@ class GenerationEngine:
         ratio = per_dtype[active_name]["max_slots"] / max(
             per_dtype["bf16"]["max_slots"], 1
         )
+        paged = (
+            self._paged_report(branch_factor=branch_factor)
+            if self.paged_kv
+            else None
+        )
         return {
+            "paged_kv": self.paged_kv,
+            "paged": paged,
             "kv_cache_dtype": active_name,
             "hbm_budget_gb": hbm_gb,
             "hot_swap": self.hot_swap,
@@ -2961,6 +3645,52 @@ class GenerationEngine:
                     (self._state, self._spec_state),
                 ),
             }
+        if self.paged_kv:
+            # Paged prefill programs take the host-planned block tables as
+            # array arguments; any in-range physical indices lower the same
+            # program, so a disjoint per-row layout stands in.
+            T = self.max_len // self.block_size
+            tab = np.zeros((group, T), np.int32)
+            for i in range(group):
+                tab[i] = 1 + i * T + np.arange(T)
+            read_t = jnp.asarray(tab)
+            programs = {
+                "decode": (self._decode_jit, (self.params, self._state)),
+                f"prefill_b{bucket_len}": (
+                    self._prefill_jit(bucket_len, group),
+                    (
+                        self.params, self._state, pbig, plen, budgets, keys,
+                        slots, read_t, read_t,
+                    ),
+                ),
+                "boundary_pack": (self._pack_boundary_jit, (self._state,)),
+            }
+            # The fork pipeline: one batch-1 shared-prompt forward
+            # (materialized) + the g-branch tile/sample/CoW-admit program
+            # (the r16 engine_paged fork programs). AOT lowering needs the
+            # forward's output shapes only, so eval_shape stands in.
+            plen1 = jnp.full((1,), min(t.sequence_length, bucket_len), jnp.int32)
+            fwd_fn = self._prefill_fork_fwd_jit(bucket_len)
+            fwd_args = (self.params, row, plen1)
+            caches1, preds1, em1 = jax.eval_shape(fwd_fn, *fwd_args)
+            programs[f"prefill_fork_fwd_b{bucket_len}"] = (fwd_fn, fwd_args)
+            programs["prefill_fork_admit"] = (
+                self._prefill_fork_admit_jit(group),
+                (
+                    self._state, row, caches1, preds1, em1, plen, budgets,
+                    keys, slots, read_t, read_t,
+                ),
+            )
+            if self.hot_swap:
+                programs["swap_reshard"] = (
+                    self._swap_reshard_jit(), (self.params,)
+                )
+            if include_prefill_stream:
+                raise NotImplementedError(
+                    "paged engines do not serve behind a dedicated prefill "
+                    "stream (see prefill_compute)"
+                )
+            return programs
         programs = {
             "decode": (self._decode_jit, (self.params, self._state)),
             f"prefill_b{bucket_len}": (
@@ -3001,7 +3731,15 @@ def _census_programs():
     from ..analysis import program_checks as pc
     from ..analysis.program_census import CensusProgram
 
-    donate = {"decode": (1,), "prefill_b8": (1,), "boundary_pack": ()}
+    donate = {
+        "decode": (1,),
+        "prefill_b8": (1,),
+        # The fork pipeline: the batch-1 forward materializes (no donation);
+        # the admit donates the engine state it rewrites (argnum 0).
+        "prefill_fork_fwd_b8": (),
+        "prefill_fork_admit": (0,),
+        "boundary_pack": (),
+    }
     spec_donate = {
         "draft_chunk": (1, 2),
         "verify": (1, 2),
@@ -3020,6 +3758,14 @@ def _census_programs():
         "engine_nohealth:prefill_b8": "engine_prefill_dp8",
         "engine_kvq:decode": "engine_kvq_dp8",
         "engine_kvq:prefill_b8": "engine_kvq_prefill_dp8",
+        # The r16 paged CoW engine: the decode budget's inventory must stay
+        # within engine_dp8's KIND SET (the block gather adds zero new
+        # collective kinds on dp8 — the pool replicates, so its updates ride
+        # the all-gather kind the monolithic merge already carries).
+        "engine_paged:decode": "engine_paged_dp8",
+        "engine_paged:prefill_b8": "engine_paged_prefill_dp8",
+        "engine_paged:prefill_fork_fwd_b8": "engine_paged_fork_prefill_dp8",
+        "engine_paged:prefill_fork_admit": "engine_paged_fork_admit_dp8",
         "engine_sampling:decode": "engine_sampling_1dev",
         "engine_spec:draft_chunk": "engine_spec_draft_dp8",
         "engine_spec:verify": "engine_spec_verify_dp8",
@@ -3032,6 +3778,7 @@ def _census_programs():
         ("engine", pc.canonical_engine_programs(8)),
         ("engine_nohealth", pc.canonical_nohealth_engine_programs(8)),
         ("engine_kvq", pc.canonical_kvq_engine_programs(8)),
+        ("engine_paged", pc.canonical_paged_engine_programs(8)),
         ("engine_sampling", pc.canonical_sampling_engine_program()),
         # The r13 speculative-decoding programs: the slot-sharded CI spec
         # engine on dp8 (the verify program's budget pins "zero new

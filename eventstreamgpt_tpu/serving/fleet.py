@@ -517,6 +517,114 @@ class ServingFleet:
         }
         return True
 
+    def fork(
+        self,
+        subject_key: Any,
+        prompt: EventStreamBatch,
+        n_branches: int,
+        max_new_events: int,
+        *,
+        lane: Optional[str] = None,
+        key=None,
+        request_id=None,
+        arrival_time: float = 0.0,
+    ) -> list[int]:
+        """Routes one shared prompt to ``subject_key``'s prefix-owning
+        service (session affinity: the same ring walk as `submit`) and
+        admits it there as ``n_branches`` copy-on-write branches
+        (`ServingService.fork` — one prefill, all branches on one
+        replica). Returns the branches' fleet admission indices; results
+        carry ``request_id=(request_id, j)``.
+
+        Key derivation: the session key is ``key`` when given, else
+        ``fold_in(fleet_key, i)`` for one consumed fleet index; branch
+        ``j`` draws from ``fold_in(session_key, j)``. Because branch
+        results are bitwise identical to independent submissions with
+        those keys (the fork contract), the fleet retains each branch as
+        an ordinary keyed request: a swap hold releases it — and an
+        eviction replays it on a survivor — through the normal one-request
+        path, re-prefilling and REBUILDING its block tables by ordinary
+        paged admission, bit-identical either way (the CoW sharing is an
+        admission-time optimization, never a recovery dependency)."""
+        sid = self.route(subject_key)
+        svc = self.services[sid]
+        lane = lane or self.default_lane or svc.default_lane
+        n_branches = int(n_branches)
+        if n_branches < 1:
+            raise ValueError("n_branches must be >= 1")
+        if max_new_events < 1:
+            raise ValueError("max_new_events must be >= 1")
+        prompt_len = int(prompt.sequence_length)
+        if prompt_len + max_new_events > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + budget ({max_new_events}) "
+                f"exceeds max_len ({self.max_len})"
+            )
+        if lane not in svc.lanes.configs:
+            raise KeyError(f"unknown lane {lane!r} on service {sid!r}")
+        if svc.replicas[0].validate_prompts:
+            reason = GenerationEngine.check_prompt_finite(prompt)
+            if reason is not None:
+                from .errors import MalformedPromptRejected
+
+                self._rejected_total += 1
+                raise MalformedPromptRejected(
+                    f"fork request {request_id!r}: {reason} — rejected at "
+                    "the fleet door (no fleet index bound)"
+                )
+        if key is None:
+            key = self._request_key(self._next_index)
+            self._next_index += 1
+        session_key = _as_raw_key(key)
+        indices = []
+        branch_requests = []
+        for j in range(n_branches):
+            index = self._next_index
+            self._next_index += 1
+            # The retained per-branch request IS an independent submission
+            # of the shared prompt under the branch's bound key — the
+            # replay/hold form of this branch.
+            internal = Request(
+                prompt=prompt,
+                max_new_events=max_new_events,
+                key=derive_request_key(session_key, j),
+                request_id=index,
+                arrival_time=arrival_time,
+                prompt_validated=True,
+            )
+            self._meta[index] = {
+                "subject": subject_key,
+                "service": sid,
+                "request_id": None if request_id is None else (request_id, j),
+                "arrival": arrival_time,
+                "request": internal,
+                "lane": lane,
+                "replays": 0,
+            }
+            indices.append(index)
+            branch_requests.append(internal)
+            self._accepted_total += 1
+        if sid in self._holding:
+            # Swap window: hold the branches like any other accepted route;
+            # the post-flip release submits them independently (bit-
+            # identical — the fork sharing is reconstructed-or-not freely).
+            for internal in branch_requests:
+                self._held[sid].append((internal, lane))
+            self._held_peak = max(
+                self._held_peak, sum(len(q) for q in self._held.values())
+            )
+        else:
+            svc.fork(
+                prompt,
+                n_branches,
+                max_new_events,
+                lane=lane,
+                key=session_key,
+                request_ids=indices,
+                arrival_time=arrival_time,
+            )
+        return indices
+
     def _wrap(self, sr: ServiceResult, sid: str) -> FleetResult:
         meta = self._meta.pop(sr.request_id)
         self._completed_total += 1

@@ -71,6 +71,30 @@ class AdmissionRejected(RuntimeError):
 
 
 @dataclasses.dataclass
+class ForkSpec:
+    """Branch metadata shared by every request of one `fork()` group.
+
+    A fork group is B branch requests over ONE shared prompt: the paged
+    engine prefills the prompt once (batch 1), lands the shared history in
+    refcounted blocks, and admits all B branches copy-on-write. The group
+    is scheduled atomically — all branches admit at one chunk boundary in
+    one admission group — and each branch's PRNG key derives as
+    ``fold_in(session_key, branch_index)`` off the session's bound key
+    (explicit ``session_key``, or ``fold_in(engine_key, admission_index of
+    branch 0)``), so branch results are bitwise identical to B independent
+    submissions of the same prompt with those per-branch keys.
+    """
+
+    group_id: int
+    n_branches: int
+    # Raw (2,) uint32 session key, or None => bound off branch 0's
+    # admission index by the engine's `_request_key`.
+    session_key: Any = None
+    # Bound at submit: branch 0's admission index (the session's index).
+    session_admission_index: int = -1
+
+
+@dataclasses.dataclass
 class Request:
     """One generation request.
 
@@ -86,6 +110,11 @@ class Request:
     key: Any = None
     request_id: Any = None
     arrival_time: float = 0.0
+    # Fork-branch metadata (paged engines only): the shared ForkSpec of
+    # this request's fork group plus this branch's index within it. None /
+    # -1 on ordinary requests.
+    fork: Optional[ForkSpec] = None
+    branch_index: int = -1
 
     # Assigned by the scheduler at submission.
     admission_index: int = -1
@@ -160,12 +189,18 @@ def make_buckets(min_bucket: int, max_prompt_len: int) -> tuple[int, ...]:
 
 @dataclasses.dataclass
 class AdmissionGroup:
-    """One prefill dispatch: same-bucket requests onto specific slots."""
+    """One prefill dispatch: same-bucket requests onto specific slots.
+
+    ``fork`` marks a fork-group admission (all requests share that
+    `ForkSpec`): ONE batch-1 prefill forward serves every branch, and the
+    admit scatter lands the shared prompt blocks once, copy-on-write.
+    """
 
     bucket_len: int
     group_size: int  # compiled program width (>= len(requests))
     requests: list[Request]
     slots: list[int]
+    fork: Optional[ForkSpec] = None
 
 
 class Scheduler:
@@ -212,6 +247,18 @@ class Scheduler:
         self._spec_proposed = 0
         self._spec_accepted = 0
         self._spec_committed = 0
+        # Prefill-work accounting: dispatches = prefill programs launched;
+        # rows = prompt forwards actually computed (a fork group's B
+        # branches share ONE batch-1 forward, so it counts 1 row — the
+        # evaluator's exactly-one-prefill-per-subject assertion reads this).
+        self._prefill_dispatches = 0
+        self._prefill_rows = 0
+        self._fork_groups = 0
+        self._fork_branches = 0
+        self._fork_deferrals = 0
+        # Paged engines install a callable here (`GenerationEngine` block
+        # allocator stats); its dict merges into `padding_report`.
+        self.block_pool_stats: Any = None
 
     def submit(self, request: Request) -> Request:
         if request.prompt_len > max(self.buckets):
@@ -227,6 +274,10 @@ class Scheduler:
             )
         request.admission_index = self._next_admission
         self._next_admission += 1
+        if request.fork is not None and request.branch_index == 0:
+            # The session's bound index: branch keys without an explicit
+            # session key fold off ``fold_in(engine_key, this index)``.
+            request.fork.session_admission_index = request.admission_index
         self.queue.append(request)
         self._max_depth = max(self._max_depth, len(self.queue))
         return request
@@ -300,35 +351,91 @@ class Scheduler:
         n_take = len(free_slots)
         if n_take == 0:
             return []
-        eligible: list[Request] = []
+        # The queue walked as indivisible UNITS: one ordinary request, or
+        # one fork group's full consecutive run of branches (fork branches
+        # are submitted back to back; an atomic take keeps the "one prefill
+        # lands the shared history, all branches admit copy-on-write at one
+        # boundary" invariant — a split group would need a second prefill).
+        units: list[list[Request]] = []
+        i = 0
+        while i < len(self.queue):
+            r = self.queue[i]
+            if r.fork is not None:
+                run = [r]
+                while (
+                    i + len(run) < len(self.queue)
+                    and self.queue[i + len(run)].fork is r.fork
+                ):
+                    run.append(self.queue[i + len(run)])
+                units.append(run)
+                i += len(run)
+            else:
+                units.append([r])
+                i += 1
+
+        eligible_units: list[list[Request]] = []
         rest: list[Request] = []
+        taken = 0
         budget_left = max_padded_events
         budget_exhausted = False
-        for r in self.queue:
-            arrived = now is None or r.arrival_time <= now
-            if len(eligible) < n_take and arrived and not budget_exhausted:
+        for unit in units:
+            arrived = now is None or all(r.arrival_time <= now for r in unit)
+            fits = taken + len(unit) <= n_take
+            if not fits and len(unit) > 1 and arrived and not budget_exhausted:
+                # A fork group that doesn't fit defers WHOLE — and, strict
+                # FIFO, everything behind it (no overtaking).
+                budget_exhausted = True
+                self._fork_deferrals += 1
+                rest.extend(unit)
+                continue
+            if fits and arrived and not budget_exhausted:
                 if budget_left is not None:
-                    cost = self.bucket_for(r.prompt_len)
-                    if eligible and cost > budget_left:
+                    # A fork group costs its bucket ONCE: one shared prefill.
+                    cost = self.bucket_for(unit[0].prompt_len)
+                    if eligible_units and cost > budget_left:
                         # Defer — and everything behind it too (strict FIFO).
                         budget_exhausted = True
                         self._prefill_deferrals += 1
-                        rest.append(r)
+                        rest.extend(unit)
                         continue
                     budget_left -= cost
-                eligible.append(r)
+                eligible_units.append(unit)
+                taken += len(unit)
             else:
-                rest.append(r)
-        if not eligible:
+                rest.extend(unit)
+        if not eligible_units:
             return []
         self.queue = rest
 
-        by_bucket: dict[int, list[Request]] = {}
-        for r in eligible:
-            by_bucket.setdefault(self.bucket_for(r.prompt_len), []).append(r)
-
         groups: list[AdmissionGroup] = []
         slot_iter = iter(free_slots)
+        by_bucket: dict[int, list[Request]] = {}
+        for unit in eligible_units:
+            if unit[0].fork is not None:
+                # One AdmissionGroup per fork group — never mixed with
+                # ordinary same-bucket requests (the fork prefill is a
+                # different program: batch-1 forward + tiled admit).
+                bucket_len = self.bucket_for(unit[0].prompt_len)
+                groups.append(
+                    AdmissionGroup(
+                        bucket_len=bucket_len,
+                        group_size=self.group_size_for(len(unit)),
+                        requests=unit,
+                        slots=[next(slot_iter) for _ in unit],
+                        fork=unit[0].fork,
+                    )
+                )
+                self._fork_groups += 1
+                self._fork_branches += len(unit)
+                self._prefill_dispatches += 1
+                self._prefill_rows += 1  # ONE shared prompt forward
+                self._prompt_events += unit[0].prompt_len
+                self._padded_events += bucket_len
+            else:
+                by_bucket.setdefault(
+                    self.bucket_for(unit[0].prompt_len), []
+                ).append(unit[0])
+
         for bucket_len in sorted(by_bucket):
             reqs = by_bucket[bucket_len]
             while reqs:
@@ -341,6 +448,8 @@ class Scheduler:
                         slots=[next(slot_iter) for _ in take],
                     )
                 )
+                self._prefill_dispatches += 1
+                self._prefill_rows += len(take)
                 for r in take:
                     self._prompt_events += r.prompt_len
                     self._padded_events += bucket_len
@@ -359,7 +468,7 @@ class Scheduler:
         the admission-queue backpressure counters and (spec mode) the
         accepted-event budget accounting."""
         padded = max(self._padded_events, 1)
-        return {
+        report = {
             "prompt_events": self._prompt_events,
             "padded_events": self._padded_events,
             "padding_waste_frac": round(1.0 - self._prompt_events / padded, 4),
@@ -376,4 +485,16 @@ class Scheduler:
             "spec_acceptance_rate": round(
                 self._spec_accepted / max(self._spec_proposed, 1), 4
             ),
+            "prefill_dispatches": self._prefill_dispatches,
+            "prefill_rows_computed": self._prefill_rows,
+            "fork_groups_admitted": self._fork_groups,
+            "fork_branches_admitted": self._fork_branches,
+            "fork_deferrals": self._fork_deferrals,
         }
+        # Paged engines: block-pool occupancy/high-water/fragmentation
+        # counters (engine-held, so they survive the engine's `reset()`
+        # recreating this scheduler).
+        stats = self.block_pool_stats
+        if stats is not None:
+            report.update(stats() if callable(stats) else dict(stats))
+        return report

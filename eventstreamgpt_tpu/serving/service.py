@@ -277,6 +277,116 @@ class ServingService:
         }
         return True
 
+    def fork(
+        self,
+        prompt: EventStreamBatch,
+        n_branches: int,
+        max_new_events: int,
+        *,
+        lane: Optional[str] = None,
+        key=None,
+        request_id=None,
+        request_ids=None,
+        arrival_time: float = 0.0,
+    ) -> list[int]:
+        """Accepts one shared prompt as ``n_branches`` copy-on-write
+        branches (paged replicas only — `GenerationEngine.fork`) and places
+        the whole group on ONE replica, so every branch shares the prefix
+        blocks the single prefill lands there. Returns the branches'
+        service admission indices.
+
+        Key derivation: the session key is ``key`` when given, else
+        ``fold_in(service_key, i)`` for one freshly consumed admission
+        index ``i``; branch ``j`` draws from ``fold_in(session_key, j)``.
+        Branch results are therefore bitwise identical to ``n_branches``
+        independent ``submit``s of the same prompt with those explicit
+        keys — wherever the group lands.
+
+        Placement is immediate (least outstanding decode budget, ties to
+        the lowest replica index — the `_place` rule): a fork group must
+        land atomically on its prefix-owning replica, which the one-pick-
+        at-a-time lane loop cannot express. ``lane`` is recorded on the
+        results for accounting; lane backpressure does not apply (the
+        engine's scheduler holds the group; its queue is unbounded here —
+        the service construction contract).
+        """
+        if not all(e.paged_kv for e in self.replicas):
+            raise ValueError(
+                "fork() needs every replica on the paged KV cache "
+                "(paged_kv=True): branches share prefix blocks copy-on-write"
+            )
+        if self.prefill_stream is not None:
+            raise NotImplementedError(
+                "fork() does not serve behind a dedicated prefill stream "
+                "(paged engines prefill locally — see "
+                "GenerationEngine.prefill_compute)"
+            )
+        lane = lane or self.default_lane
+        if lane not in self.lanes.configs:
+            raise KeyError(f"unknown lane {lane!r}")
+        n_branches = int(n_branches)
+        if n_branches < 1:
+            raise ValueError("n_branches must be >= 1")
+        if request_ids is not None and len(request_ids) != n_branches:
+            raise ValueError(
+                f"request_ids has {len(request_ids)} entries for "
+                f"{n_branches} branches"
+            )
+        if max_new_events < 1:
+            raise ValueError("max_new_events must be >= 1")
+        prompt_len = int(prompt.sequence_length)
+        if prompt_len + max_new_events > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + budget ({max_new_events}) "
+                f"exceeds max_len ({self.max_len})"
+            )
+        if self.replicas[0].validate_prompts:
+            reason = GenerationEngine.check_prompt_finite(prompt)
+            if reason is not None:
+                from .errors import MalformedPromptRejected
+
+                self.lanes.rejected[lane] += 1
+                raise MalformedPromptRejected(
+                    f"fork request {request_id!r}: {reason} — rejected at "
+                    "the service door (no admission index bound)"
+                )
+        if key is None:
+            # The session consumes one admission index, exactly like an
+            # accepted request — so the surrounding admitted set's keys
+            # are untouched by whether a slot of traffic was a fork.
+            key = self._request_key(self._next_index)
+            self._next_index += 1
+        session_key = _as_raw_key(key)
+        ri = min(
+            range(len(self.replicas)), key=lambda i: (self._outstanding[i], i)
+        )
+        indices = []
+        for j in range(n_branches):
+            index = self._next_index
+            self._next_index += 1
+            if request_ids is not None:
+                rid = request_ids[j]
+            else:
+                rid = None if request_id is None else (request_id, j)
+            self._meta[index] = {
+                "lane": lane,
+                "request_id": rid,
+                "arrival": arrival_time,
+                "budget": max_new_events,
+                "replica": ri,
+            }
+            indices.append(index)
+        self._outstanding[ri] += n_branches * max_new_events
+        self.replicas[ri].fork(
+            prompt,
+            n_branches,
+            max_new_events,
+            key=session_key,
+            request_ids=indices,
+            arrival_time=arrival_time,
+        )
+        return indices
+
     # ------------------------------------------------------------ placement
     def _place(self) -> None:
         """Budget-aware placement of lane picks onto replica queues.

@@ -123,6 +123,15 @@ def get_generative_predictions(
     (subject, sample) with key ``fold_in(key, row_index)``, dead rows
     stopping early on device instead of burning the full horizon. The
     labeling/aggregation tail is identical.
+
+    A PAGED engine (``paged_kv=True``) routes through
+    `GenerationEngine.fork` instead: subject ``s``'s shared history
+    prefills ONCE into refcounted copy-on-write blocks and its
+    ``num_samples`` branches draw from ``fold_in(fold_in(key, s), j)`` —
+    one prefill per subject instead of ``num_samples`` (the scheduler's
+    ``prefill_rows_computed`` counter shows exactly one row per subject),
+    branch results bitwise equal to per-(subject, sample) requests with
+    those explicit keys.
     """
     if engine is not None:
         generated = _generate_via_engine(
@@ -163,16 +172,34 @@ def _generate_via_engine(engine, batch, key: jax.Array, num_samples: int, max_ne
     expanded = batch.repeat_batch_elements(num_samples)
     n_rows = expanded.batch_size
     prompt_len = batch.sequence_length
-    requests = [
-        Request(
-            prompt=expanded.slice((slice(i, i + 1), slice(None))),
-            max_new_events=max_new_events,
-            key=jax.random.fold_in(key, i),
-            request_id=i,
-        )
-        for i in range(n_rows)
-    ]
-    results = engine.run(requests)
+    if engine.paged_kv:
+        # One prefill per SUBJECT: subject s's history lands once in
+        # frozen CoW blocks and its num_samples branches share it,
+        # branch j drawing from fold_in(fold_in(key, s), j). Branch
+        # results are bitwise equal to per-(subject, sample) requests
+        # with those keys (the fork contract) — the evaluator's paged
+        # parity pin. The non-paged flat fold_in(key, row) derivation
+        # below is untouched (byte-stable with its own pins).
+        for s in range(batch.batch_size):
+            engine.fork(
+                batch.slice((slice(s, s + 1), slice(None))),
+                num_samples,
+                max_new_events,
+                key=jax.random.fold_in(key, s),
+                request_ids=[s * num_samples + j for j in range(num_samples)],
+            )
+        results = engine.run()
+    else:
+        requests = [
+            Request(
+                prompt=expanded.slice((slice(i, i + 1), slice(None))),
+                max_new_events=max_new_events,
+                key=jax.random.fold_in(key, i),
+                request_id=i,
+            )
+            for i in range(n_rows)
+        ]
+        results = engine.run(requests)
 
     # Reassemble into the fixed cohort shape; rows stopped early pad out
     # with masked events exactly where generate() would have written them.
@@ -220,11 +247,14 @@ def zero_shot_evaluation(
     """Runs zero-shot evaluation over tuning + held-out (reference ``:304-391``).
 
     Generation routes through the continuous-batching serving engine by
-    default (``serving/engine.py``): per-(subject, sample) requests with
-    ``fold_in`` keys, bucketed prefill, and per-row early stopping — rows
-    whose prompts are padding-short stop on device instead of replaying the
-    full horizon. ``use_engine=False`` keeps the PR4 cohort ``generate()``
-    path (one fused program per cohort shape, whole-batch stopping).
+    default (``serving/engine.py``) with the paged copy-on-write KV cache:
+    each subject's history prefills ONCE and its ``num_samples`` branches
+    `fork` off the shared blocks with per-branch ``fold_in`` keys — plus
+    bucketed prefill and per-row early stopping (rows whose prompts are
+    padding-short stop on device instead of replaying the full horizon).
+    NA models keep the monolithic per-(subject, sample) request path.
+    ``use_engine=False`` keeps the PR4 cohort ``generate()`` path (one
+    fused program per cohort shape, whole-batch stopping).
     """
     np.random.seed(cfg.seed)
     key = jax.random.PRNGKey(cfg.seed)
@@ -273,22 +303,39 @@ def zero_shot_evaluation(
 
     engine = None
     if use_engine:
+        from ..models.config import StructuredEventProcessingMode
         from ..serving import GenerationEngine
 
         n_slots = batch_size * num_samples
+        max_len = tuning_pyd.max_seq_len + max_new_events
+        # Paged CoW cache by default: each subject's shared history
+        # prefills once and its num_samples branches fork off it
+        # (`_generate_via_engine`). NA models keep the monolithic cache
+        # (the paged layout is CI-only; the engine refuses the pair
+        # loudly). block_size: the largest divisor of max_len <= 16
+        # (the engine requires block_size | max_len).
+        paged = (
+            config.structured_event_processing_mode
+            != StructuredEventProcessingMode.NESTED_ATTENTION
+        )
+        block_size = next(
+            b for b in range(min(16, max_len), 0, -1) if max_len % b == 0
+        )
         engine = GenerationEngine(
             model,
             params,
             config,
             template=init_batch,
             n_slots=n_slots,
-            max_len=tuning_pyd.max_seq_len + max_new_events,
+            max_len=max_len,
             max_prompt_len=tuning_pyd.max_seq_len,
             # The engine key only seeds requests submitted WITHOUT explicit
             # keys; the evaluator always passes explicit fold_in keys. Fold
             # on a sentinel so the eval key itself is never consumed twice.
             base_key=jax.random.fold_in(key, 2**31 - 1),
             mesh=mesh,
+            paged_kv=paged,
+            block_size=block_size if paged else 16,
         )
 
     results = {}

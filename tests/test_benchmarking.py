@@ -139,6 +139,15 @@ class TestBenchTailCapture:
         "etl_parallel_events_per_sec",
         "etl_vs_serial_ratio",
         "zeroshot_auroc",
+        # r16 paged-CoW fork verdicts: the zero-shot branching workload
+        # through fork() vs per-(subject, sample) requests on identical
+        # paged engines (bitwise-equal outputs pinned in
+        # tests/test_paged_cache.py) — the shared-prefill speedup, the
+        # admission-dedup scoreboard, and the measured capacity multiplier
+        # from CoW prefix sharing.
+        "zeroshot_fork_speedup",
+        "paged_effective_slots_ratio",
+        "fork_branches_per_prefill",
         "value",
     ]
 

@@ -1,0 +1,483 @@
+"""Tests for the paged copy-on-write KV cache and `fork()` (docs/serving.md
+"Paged KV cache and branched rollouts").
+
+The load-bearing invariants:
+
+* **Paged ≡ monolithic**: on non-forked workloads the block-pool engine is
+  bitwise identical to the per-slot monolithic cache — same results through
+  refill, at any decode-chunk size, and composed with the int8 quantized
+  cache. Paging changes WHERE KV rows live, never what they contain.
+* **The fork contract**: ``fork(prompt, B)`` runs ONE prefill forward
+  (scheduler counters prove it) and its B branch results are bitwise
+  identical to B independent submissions of the same prompt with keys
+  ``derive_request_key(session, j)`` — against both a paged and a
+  monolithic reference engine. Branch bits are invariant to co-resident
+  tenants, admission order, and decode-chunk size (CoW isolation: a
+  branch writing its private tail can never perturb a sibling).
+* **Capacity**: with B branches sharing a long prefix, the measured
+  ``effective_slots`` approaches B× the monolithic slot count; block-pool
+  high-water/fragmentation counters survive ``reset()``.
+* **One level up**: service/fleet ``fork()`` keeps session affinity, and an
+  evicted forked session replays bit-identical on the survivor replica —
+  replay reconstructs block tables through ordinary paged admission, it
+  never depends on the dead replica's CoW sharing.
+* **Evaluator**: the zero-shot evaluator's paged path computes one prefill
+  per subject and predictions bitwise equal to the per-(subject, sample)
+  request path with the fork keys.
+
+The compact parity pin and fork-contract pin run in tier-1; the wider
+e2e matrix (refill/chunk/kvq, co-residency, service/fleet, capacity,
+evaluator) is marked slow and runs in its own CI chunk.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.models.ci_model import CIPPTForGenerativeSequenceModeling
+from eventstreamgpt_tpu.serving import GenerationEngine, Request
+from eventstreamgpt_tpu.serving.engine import derive_request_key
+from eventstreamgpt_tpu.serving.fleet import ServingFleet
+from eventstreamgpt_tpu.serving.service import ServingService
+
+from .test_generation import ci_config, make_prompt
+
+pytestmark = pytest.mark.serving
+
+MAX_LEN = 8
+BLOCK = 4
+
+
+def build_ci():
+    config = ci_config()
+    prompt = make_prompt(B=4, L=4)
+    model = CIPPTForGenerativeSequenceModeling(config)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    return config, model, params, prompt
+
+
+@pytest.fixture(scope="module")
+def ci():
+    return build_ci()
+
+
+def engine_for(ci, *, paged=True, **kw):
+    config, model, params, prompt = ci
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("min_bucket", 2)
+    if paged:
+        kw.setdefault("paged_kv", True)
+        kw.setdefault("block_size", BLOCK)
+    return GenerationEngine(model, params, config, template=prompt, **kw)
+
+
+def mixed_requests(prompt, n=4, start_id=0):
+    reqs = []
+    for i in range(start_id, start_id + n):
+        Lp = 3 if i % 2 == 0 else 4
+        reqs.append(
+            Request(
+                prompt=prompt.slice((slice(i % 4, i % 4 + 1), slice(0, Lp))),
+                max_new_events=MAX_LEN - Lp,
+                key=jax.random.fold_in(jax.random.PRNGKey(42), i),
+                request_id=i,
+            )
+        )
+    return reqs
+
+
+def assert_same_content(a, b):
+    assert a.n_generated == b.n_generated
+    for f in ("event_mask", "time_delta", "dynamic_indices", "dynamic_values"):
+        xa, xb = getattr(a.batch, f), getattr(b.batch, f)
+        if xa is None:
+            assert xb is None
+            continue
+        np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+
+
+def by_id(results):
+    return {r.request_id: r for r in results}
+
+
+def fork_reference_requests(prompt, session, n_branches, budget, tag="f"):
+    """The B independent submissions a fork must reproduce bit-for-bit."""
+    return [
+        Request(
+            prompt=prompt,
+            max_new_events=budget,
+            key=derive_request_key(session, j),
+            request_id=(tag, j),
+        )
+        for j in range(n_branches)
+    ]
+
+
+# -------------------------------------------------- acceptance pins (tier-1)
+class TestPagedParityPin:
+    def test_paged_bit_identical_to_monolithic(self, ci):
+        """The acceptance pin: the same accepted set through the monolithic
+        engine and the paged engine — identical per-request outputs, bit
+        for bit, including a refill wave (4 requests through 2 slots)."""
+        _, _, _, prompt = ci
+        mono = engine_for(ci, paged=False).run(mixed_requests(prompt))
+        paged = engine_for(ci).run(mixed_requests(prompt))
+        assert len(mono) == len(paged) == 4
+        for a, b in zip(mono, paged):
+            assert a.request_id == b.request_id
+            assert_same_content(a, b)
+
+    def test_fork_is_one_prefill_and_matches_independent(self, ci):
+        """The fork contract, compact: one prefill row admits B branches
+        (scheduler counters), and branch results are bitwise equal to B
+        independent submissions with ``derive_request_key(session, j)``
+        keys through a monolithic engine — fork is an admission-time
+        optimization, never a semantic change. The 3-long prompt also
+        exercises the partial-block CoW copy (prompt not block-aligned)."""
+        _, _, _, prompt = ci
+        row = prompt.slice((slice(0, 1), slice(0, 3)))
+        session = jax.random.PRNGKey(7)
+
+        eng = engine_for(ci)
+        eng.fork(row, 2, MAX_LEN - 3, key=session, request_id="f")
+        forked = by_id(eng.run())
+        rep = eng.scheduler.padding_report()
+        assert rep["prefill_dispatches"] == 1
+        assert rep["prefill_rows_computed"] == 1
+        assert rep["fork_groups_admitted"] == 1
+        assert rep["fork_branches_admitted"] == 2
+
+        ref = by_id(
+            engine_for(ci, paged=False).run(
+                fork_reference_requests(row, session, 2, MAX_LEN - 3)
+            )
+        )
+        assert set(forked) == set(ref) == {("f", 0), ("f", 1)}
+        for k in forked:
+            assert_same_content(forked[k], ref[k])
+
+
+# ------------------------------------------------------------------ slow e2e
+@pytest.mark.slow
+class TestPagedMonolithicE2E:
+    def test_refill_and_chunk_size_invariance(self, ci):
+        """6 requests through 2 slots (three refill waves) — paged equals
+        monolithic bitwise, and the paged results are themselves invariant
+        to decode-chunk size."""
+        _, _, _, prompt = ci
+        mono = engine_for(ci, paged=False).run(mixed_requests(prompt, n=6))
+        paged2 = engine_for(ci).run(mixed_requests(prompt, n=6))
+        paged3 = engine_for(ci, decode_chunk=3).run(mixed_requests(prompt, n=6))
+        for a, b, c in zip(mono, paged2, paged3):
+            assert_same_content(a, b)
+            assert_same_content(a, c)
+
+    def test_int8_kvq_composes(self, ci):
+        """Quantize-on-write survives the paging refactor: the int8-cache
+        paged engine equals the int8-cache monolithic engine bitwise."""
+        _, _, _, prompt = ci
+        mono = engine_for(ci, paged=False, kv_cache_dtype="int8").run(
+            mixed_requests(prompt)
+        )
+        paged = engine_for(ci, kv_cache_dtype="int8").run(mixed_requests(prompt))
+        for a, b in zip(mono, paged):
+            assert_same_content(a, b)
+
+
+@pytest.mark.slow
+class TestForkDeterminism:
+    def test_fork_matches_independent_paged_and_monolithic(self, ci):
+        """B=3 branches against BOTH reference engines; the block-aligned
+        4-long prompt exercises the no-partial-block fork edge (the shared
+        prefix is exactly one frozen block, branch tails start fresh)."""
+        _, _, _, prompt = ci
+        row = prompt.slice((slice(0, 1), slice(0, 4)))
+        session = jax.random.PRNGKey(11)
+        eng = engine_for(ci, n_slots=4)
+        eng.fork(row, 3, 4, key=session, request_id="f")
+        forked = by_id(eng.run())
+
+        for paged in (True, False):
+            ref = by_id(
+                engine_for(ci, paged=paged, n_slots=4).run(
+                    fork_reference_requests(row, session, 3, 4)
+                )
+            )
+            for k in forked:
+                assert_same_content(forked[k], ref[k])
+
+        # CoW isolation produced REAL divergence: sibling branches sampled
+        # different continuations from their fold_in keys while sharing the
+        # frozen prefix — bitwise-equal branches would mean the per-branch
+        # key derivation collapsed.
+        td0 = np.asarray(forked[("f", 0)].batch.time_delta)
+        td1 = np.asarray(forked[("f", 1)].batch.time_delta)
+        np.testing.assert_array_equal(td0[:, :3], td1[:, :3])
+        assert not np.array_equal(td0, td1)
+
+    def test_fork_invariant_to_coresidents_and_admission_order(self, ci):
+        """Branch bits do not depend on what else is resident or on where
+        the group sits in the queue — the fork group admitted alone, after
+        a background wave, and before one, all bitwise equal (and the
+        background requests keep their own solo-run bits: a diverging
+        branch never writes into a neighbour's blocks)."""
+        _, _, _, prompt = ci
+        row = prompt.slice((slice(0, 1), slice(0, 3)))
+        session = jax.random.PRNGKey(13)
+        bg = lambda: mixed_requests(prompt, n=2, start_id=100)
+
+        solo_eng = engine_for(ci, n_slots=4)
+        solo_eng.fork(row, 2, 5, key=session, request_id="f")
+        solo = by_id(solo_eng.run())
+        bg_solo = by_id(engine_for(ci, n_slots=4).run(bg()))
+
+        fork_first = engine_for(ci, n_slots=4)
+        fork_first.fork(row, 2, 5, key=session, request_id="f")
+        for r in bg():
+            fork_first.submit(r)
+        mixed_a = by_id(fork_first.run())
+
+        fork_last = engine_for(ci, n_slots=4)
+        for r in bg():
+            fork_last.submit(r)
+        fork_last.fork(row, 2, 5, key=session, request_id="f")
+        mixed_b = by_id(fork_last.run())
+
+        for j in range(2):
+            assert_same_content(mixed_a[("f", j)], solo[("f", j)])
+            assert_same_content(mixed_b[("f", j)], solo[("f", j)])
+        for i in (100, 101):
+            assert_same_content(mixed_a[i], bg_solo[i])
+            assert_same_content(mixed_b[i], bg_solo[i])
+
+    def test_fork_chunk_size_invariance(self, ci):
+        _, _, _, prompt = ci
+        row = prompt.slice((slice(0, 1), slice(0, 3)))
+        session = jax.random.PRNGKey(17)
+        outs = []
+        for chunk in (1, 2, 3):
+            eng = engine_for(ci, n_slots=4, decode_chunk=chunk)
+            eng.fork(row, 3, 5, key=session, request_id="f")
+            outs.append(by_id(eng.run()))
+        for j in range(3):
+            assert_same_content(outs[0][("f", j)], outs[1][("f", j)])
+            assert_same_content(outs[0][("f", j)], outs[2][("f", j)])
+
+
+@pytest.mark.slow
+class TestForkThroughServiceAndFleet:
+    def test_service_fork_parity_and_placement(self, ci):
+        """`ServingService.fork` places the whole group on ONE replica
+        (branches share blocks only inside an engine) and reproduces
+        independent service submissions with the branch keys bitwise."""
+        _, _, _, prompt = ci
+        row = prompt.slice((slice(0, 1), slice(0, 3)))
+        session = jax.random.PRNGKey(19)
+
+        svc = ServingService(
+            [engine_for(ci, n_slots=4), engine_for(ci, n_slots=4)],
+            base_key=jax.random.PRNGKey(1),
+        )
+        svc.fork(row, 3, 5, key=session, request_id="grp")
+        res = by_id(svc.run())
+        assert set(res) == {("grp", j) for j in range(3)}
+        owners = {res[("grp", j)].replica for j in range(3)}
+        assert len(owners) == 1, "fork group split across replicas"
+        rep = svc.replicas[owners.pop()].scheduler.padding_report()
+        assert rep["prefill_rows_computed"] == 1
+        assert rep["fork_branches_admitted"] == 3
+
+        svc2 = ServingService(
+            [engine_for(ci, n_slots=4), engine_for(ci, n_slots=4)],
+            base_key=jax.random.PRNGKey(1),
+        )
+        ref = by_id(svc2.run(fork_reference_requests(row, session, 3, 5, "grp")))
+        for k in res:
+            assert_same_content(res[k], ref[k])
+
+    def test_fleet_fork_affinity_and_eviction_replay(self, ci):
+        """Fleet fork routes by subject affinity; evicting the owning
+        service replays all branches on the survivor bit-identically.
+        Replay admits each branch as an ordinary keyed request — the
+        survivor's counters show B prefill ROWS (not a fork group),
+        proving block tables were REBUILT by paged admission rather than
+        recovered from the dead replica's sharing state."""
+        _, _, _, prompt = ci
+        row = prompt.slice((slice(0, 1), slice(0, 3)))
+        session = jax.random.PRNGKey(23)
+
+        def fresh_fleet():
+            return ServingFleet(
+                [
+                    ServingService([engine_for(ci, n_slots=4)]),
+                    ServingService([engine_for(ci, n_slots=4)]),
+                ],
+                base_key=jax.random.PRNGKey(2),
+            )
+
+        fleet = fresh_fleet()
+        fleet.fork("subjectA", row, 3, 5, key=session, request_id="g")
+        res = by_id(fleet.run())
+        sids = {res[("g", j)].service for j in range(3)}
+        assert sids == {fleet.route("subjectA")}
+        assert fleet.swap_report()["swap_dropped_requests"] == 0
+
+        evicted = fresh_fleet()
+        sid = evicted.route("subjectA")
+        evicted.fork("subjectA", row, 3, 5, key=session, request_id="g")
+        assert evicted.evict_service(sid, reason="test") == 3
+        replayed = by_id(evicted.run())
+        survivor = next(s for s in evicted.services if s != sid)
+        rep = evicted.services[survivor].replicas[0].scheduler.padding_report()
+        assert rep["prefill_rows_computed"] == 3  # rebuilt, not forked
+        assert rep["fork_groups_admitted"] == 0
+        assert rep["block_pool_high_water"] > 0
+        for j in range(3):
+            assert replayed[("g", j)].replays == 1
+            assert replayed[("g", j)].service == survivor
+            assert_same_content(replayed[("g", j)], res[("g", j)])
+
+
+@pytest.mark.slow
+class TestBlockPoolCapacity:
+    def test_effective_slots_at_branch_factor(self, ci):
+        """A prefix-dominated fork (45-long prompt, 8 branches, 8 slots)
+        measured mid-residency: branches share 11 frozen prefix blocks, so
+        the pool could host >= 0.8 * B * n_slots branch-shaped tenants —
+        the ISSUE's capacity acceptance bound."""
+        config, model, params, _ = ci
+        long_prompt = make_prompt(B=1, L=45)
+        eng = GenerationEngine(
+            model,
+            params,
+            config,
+            template=long_prompt,
+            n_slots=8,
+            max_len=64,
+            decode_chunk=1,
+            min_bucket=2,
+            paged_kv=True,
+            block_size=BLOCK,
+        )
+        B = 8
+        eng.fork(long_prompt, B, 3, key=jax.random.PRNGKey(29), request_id="f")
+        assert eng.plan_and_dispatch() == B
+        paged = eng.slots_report(branch_factor=B)["paged"]
+        assert paged["resident_rows"] == B
+        assert paged["sharing_ratio"] > 3.0  # 11 frozen blocks shared 8 ways
+        assert paged["effective_slots"] >= 0.8 * B * 8
+        assert paged["bytes_per_block"] > 0
+        results = eng.run()
+        assert len(results) == B
+
+    def test_pool_counters_survive_reset(self, ci):
+        _, _, _, prompt = ci
+        eng = engine_for(ci)
+        eng.run(mixed_requests(prompt))
+        hw = eng._block_alloc.high_water
+        assert hw > 0
+        before = eng.scheduler.padding_report()
+        assert before["block_pool_high_water"] == hw
+        eng.reset()
+        assert eng._block_alloc.in_use == 0
+        assert eng._block_alloc.high_water == hw
+        after = eng.scheduler.padding_report()
+        assert after["block_pool_high_water"] == hw
+
+
+@pytest.mark.slow
+class TestEvaluatorFork:
+    def test_one_prefill_per_subject_and_prediction_parity(self, ci):
+        """The zero-shot evaluator's paged default: each subject prefills
+        exactly once (scheduler counters) and the aggregated predictions
+        are bitwise equal to the per-(subject, sample) request path with
+        the fork keys ``derive_request_key(fold_in(key, s), j)``."""
+        from eventstreamgpt_tpu.data.types import EventStreamBatch
+        from eventstreamgpt_tpu.models.zero_shot_labeler import Labeler
+        from eventstreamgpt_tpu.training.zero_shot_evaluator import (
+            _aggregate_predictions,
+            get_generative_predictions,
+        )
+
+        config, model, params, prompt = ci
+        config.finetuning_task = "task"
+        config.num_labels = 2
+        config.id2label = {0: False, 1: True}
+
+        class CountLabeler(Labeler):
+            def __call__(self, batch, input_seq_len):
+                future = np.asarray(batch.event_mask)[:, input_seq_len:]
+                pos = future.sum(axis=1) >= 2
+                labels = np.zeros((len(pos), 2), np.float32)
+                labels[np.arange(len(pos)), pos.astype(np.int64)] = 1.0
+                return labels, np.zeros(len(pos), bool)
+
+        labeler = CountLabeler(config=config)
+        batch = prompt.replace(
+            stream_labels={"task": jnp.asarray([0, 1, 0, 1])},
+            event_mask=prompt.event_mask.at[2, 3:].set(False),
+        )
+        key = jax.random.PRNGKey(31)
+        num_samples, budget = 2, 4
+
+        eng = engine_for(ci, n_slots=4)
+        out_e, frac_e = get_generative_predictions(
+            model, params, config, labeler, batch, key,
+            num_samples=num_samples, max_new_events=budget, engine=eng,
+        )
+        rep = eng.scheduler.padding_report()
+        assert rep["prefill_rows_computed"] == batch.batch_size
+        assert rep["fork_groups_admitted"] == batch.batch_size
+        assert rep["fork_branches_admitted"] == batch.batch_size * num_samples
+
+        # Reference: one request per (subject, sample) with the fork keys,
+        # assembled into the same cohort shape, aggregated identically.
+        expanded = batch.repeat_batch_elements(num_samples)
+        reqs = [
+            Request(
+                prompt=expanded.slice((slice(i, i + 1), slice(None))),
+                max_new_events=budget,
+                key=derive_request_key(
+                    jax.random.fold_in(key, i // num_samples), i % num_samples
+                ),
+                request_id=i,
+            )
+            for i in range(expanded.batch_size)
+        ]
+        results = engine_for(ci, paged=False, n_slots=4).run(reqs)
+        target_len = batch.sequence_length + budget
+        M = batch.n_data_elements
+        n_rows = expanded.batch_size
+        out = {
+            "event_mask": np.zeros((n_rows, target_len), bool),
+            "time_delta": np.zeros((n_rows, target_len), np.float32),
+            "dynamic_indices": np.zeros((n_rows, target_len, M), np.int64),
+            "dynamic_measurement_indices": np.zeros(
+                (n_rows, target_len, M), np.int64
+            ),
+            "dynamic_values": np.zeros((n_rows, target_len, M), np.float32),
+            "dynamic_values_mask": np.zeros((n_rows, target_len, M), bool),
+        }
+        for res in results:
+            i = res.request_id
+            n = min(res.n_events, target_len)
+            for field, dst in out.items():
+                dst[i, :n] = np.asarray(getattr(res.batch, field))[0, :n].astype(
+                    dst.dtype
+                )
+        ref_generated = EventStreamBatch(
+            static_indices=np.asarray(expanded.static_indices),
+            static_measurement_indices=np.asarray(
+                expanded.static_measurement_indices
+            ),
+            **out,
+        )
+        out_r, frac_r = _aggregate_predictions(
+            ref_generated, batch, config, labeler, num_samples
+        )
+        np.testing.assert_array_equal(out_e.preds, out_r.preds)
+        np.testing.assert_array_equal(out_e.labels, out_r.labels)
+        np.testing.assert_array_equal(frac_e, frac_r)
