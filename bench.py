@@ -1860,6 +1860,15 @@ def main():
                 "paged_pool_utilization": paged_cap["pool_utilization"],
                 "paged_sharing_ratio": paged_cap["sharing_ratio"],
                 "paged_block_pool_high_water": fork_rep["block_pool_high_water"],
+                # Tier D model-checker coverage (r17): total post-POR
+                # control-plane interleavings pinned in MODELCHECK.json —
+                # the committed artifact, not a re-exploration, so the
+                # bench stays cheap while the artifact records how much
+                # schedule space the serving claims above were checked
+                # against (CI re-verifies the pins byte-identically).
+                "modelcheck_schedules_explored": json.loads(
+                    (Path(__file__).resolve().parent / "MODELCHECK.json").read_text()
+                )["total_schedules"],
                 # ---- headline block (must stay last: the driver captures
                 # only the final 2000 chars of stdout; per-chip units).
                 # Production-width remat-policy A/B (r06 lever 1): both arms

@@ -1,13 +1,16 @@
 """graftcheck: JAX-aware static analysis for the TPU-native ESGPT stack.
 
-Three tiers, one CLI (``scripts/graftcheck.py``):
+Four tiers, one CLI (``scripts/graftcheck.py``):
 
-* Tier A — ``lint``: custom AST rules (GC001-GC005) over the package for the
+* Tier A — ``lint``: custom AST rules (GC001-GC008) over the package for the
   TPU footguns runtime tests only catch after they've burned a pod-hour:
   host syncs reachable from traced scopes or jitted-dispatch loops, f64
   dtype creep, PRNG key reuse, Python control flow on traced values, and
   undonated state-updating jits (train/fine-tune steps and the serving
-  decode/prefill/dispatch programs).
+  decode/prefill/dispatch programs). GC006-GC008 are the serving-scoped
+  determinism lint: unordered-set iteration in decision paths,
+  nondeterministic sources (salted ``hash()``, wall clocks, ``random``,
+  uuid), and block-ledger mutation outside the sanctioned owners.
 * Tier B — ``program_checks``: AOT-lower the canonical pretrain / fine-tune /
   generation step programs and assert static facts of the lowered module:
   no f64 element types, no host transfers, collective payload bytes within
@@ -20,6 +23,17 @@ Three tiers, one CLI (``scripts/graftcheck.py``):
   fit), donation-aliasing completeness, implicit resharding, and
   kind-resolved collective inventories (the scaled fsdp8 backward must
   show reduce-scatter).
+* Tier D — ``model_check``: the serving control-plane model checker. A
+  bounded exhaustive-interleaving explorer with sleep-set partial-order
+  reduction drives the REAL Scheduler / GenerationEngine / ServingService /
+  ServingFleet (tiny widths, virtual CPU mesh) through every post-POR
+  schedule of enabled control-plane actions, checking the
+  ``serving/sanitizer.py`` oracles (block-pool refcount conservation,
+  zero-drop ledger, slot-epoch stale-boundary guard, strict-FIFO boundary
+  resolution, one-time admission binding, session affinity) at every state
+  and outcome determinism vs a canonical reference drain at every leaf;
+  violations shrink to a minimal failing schedule, and per-scenario
+  schedule counts pin byte-reproducibly in ``MODELCHECK.json``.
 * ``compile_guard``: a recompilation sentinel (context manager over the jit
   trace caches / ``jax.monitoring`` compile events) used by tests and by
   ``training/pretrain.py`` to fail fast if the step recompiles mid-epoch.

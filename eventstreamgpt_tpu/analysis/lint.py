@@ -22,6 +22,29 @@ after they've burned real accelerator time:
 * **GC005** a train-step ``jax.jit`` without ``donate_argnums``: the
   optimizer state is double-buffered and peak HBM nearly doubles.
 
+Three rules (the Tier D determinism lint) are scoped to ``serving/`` —
+the control plane whose contract is bitwise schedule-invariance, so ANY
+nondeterminism in a decision path is a results bug, not a style nit:
+
+* **GC006** iteration over a set/frozenset feeding serving decisions
+  (placement, admission, eviction order). Set iteration order varies
+  per process (``PYTHONHASHSEED``); wrap in ``sorted(...)``. Membership
+  tests are fine — only iteration is flagged.
+* **GC007** nondeterministic sources in serving code: builtin ``hash()``
+  (process-salted), wall-clock reads (``time.time``/``time_ns``,
+  ``datetime.now``/``utcnow``), the global ``random`` module,
+  ``os.urandom``, ``uuid.uuid4``. Use ``router.stable_hash``, injected
+  logical clocks, and derived PRNG keys. ``time.perf_counter`` /
+  ``time.monotonic`` are sanctioned (latency measurement, never a
+  decision input).
+* **GC008** block-ledger discipline: ``.alloc``/``.incref``/``.decref``/
+  ``.reset_occupancy`` on a ``_block_alloc`` (or touching its ``_free``/
+  ``_rc`` internals) outside the sanctioned owners — the allocator class
+  itself, ``_plan_admission_tables``, ``_free_slot_blocks``, ``reset``.
+  Unpaired alloc/free scattered through the control plane is how
+  double-frees are born; `serving.sanitizer` catches them at runtime,
+  this rule catches the call site at review time.
+
 Scope analysis is intentionally heuristic (module-local call graph +
 lexical nesting + simple local-variable dataflow); precision comes from the
 checked-in baseline (``analysis/baseline.json`` suppresses pre-existing
@@ -58,7 +81,37 @@ RULES: dict[str, str] = {
     "GC003": "PRNG key consumed twice without an intervening split/fold_in",
     "GC004": "Python if/while on a traced value inside a traced scope",
     "GC005": "state-updating jit (train/fine-tune step, decode/prefill/dispatch) without donate_argnums",
+    "GC006": "iteration over an unordered set in a serving decision path",
+    "GC007": "nondeterministic source (hash/wall-clock/random/uuid) in serving code",
+    "GC008": "block alloc/free outside the sanctioned ledger owners",
 }
+
+# GC006-GC008 only run on the serving control plane (the code whose
+# contract is bitwise schedule-invariance).
+_SERVING_PATH_RE = re.compile(r"(^|/)serving/")
+
+# GC007 vocabulary. Dotted prefixes are matched against the full chain;
+# `perf_counter`/`monotonic` are deliberately absent (latency measurement
+# is sanctioned — it must never feed a decision, which GC006/Tier D catch).
+_NONDET_DOTTED = {
+    "time.time": "wall-clock read — serving decisions take an injected logical clock",
+    "time.time_ns": "wall-clock read — serving decisions take an injected logical clock",
+    "datetime.now": "wall-clock read — serving decisions take an injected logical clock",
+    "datetime.utcnow": "wall-clock read — serving decisions take an injected logical clock",
+    "datetime.datetime.now": "wall-clock read — serving decisions take an injected logical clock",
+    "datetime.datetime.utcnow": "wall-clock read — serving decisions take an injected logical clock",
+    "os.urandom": "OS entropy — derive from the engine's PRNG key instead",
+    "uuid.uuid4": "random UUID — derive ids from admission indices or stable_hash",
+}
+_NONDET_MODULE_ROOTS = {
+    "random": "the global `random` module is seeded per process — use numpy "
+    "Generator with a fixed seed or a derived jax PRNG key",
+}
+
+# GC008: the ledger mutators, and the scopes allowed to call them.
+_LEDGER_METHODS = {"alloc", "incref", "decref", "reset_occupancy"}
+_LEDGER_INTERNALS = {"_free", "_rc"}
+_LEDGER_OWNER_FUNCS = {"_plan_admission_tables", "_free_slot_blocks", "reset"}
 
 # GC005 trigger vocabulary: jits of state-updating steps. "train" covers the
 # pretrain AND fine-tune step factories (both jit `*train_step*` bodies);
@@ -423,6 +476,10 @@ class _Linter:
         self.check_gc003()
         self.check_gc004()
         self.check_gc005()
+        if _SERVING_PATH_RE.search(self.path.replace("\\", "/")):
+            self.check_gc006()
+            self.check_gc007()
+            self.check_gc008()
         # The loop scan can reach one site via several paths (direct + shared
         # helpers) — one site, one finding.
         seen: set[tuple[int, int, str]] = set()
@@ -866,6 +923,169 @@ class _Linter:
                             f"scope `{f.name}`",
                             hint,
                         )
+
+    # ------------------------------------------------------------- GC006
+    def check_gc006(self) -> None:
+        hint = (
+            "set iteration order varies per process (PYTHONHASHSEED); wrap in "
+            "sorted(...) so placement/admission order is a pure function of the "
+            "request stream"
+        )
+
+        def is_set_expr(node: ast.AST, local_sets: set[str]) -> bool:
+            if isinstance(node, (ast.Set, ast.SetComp)):
+                return True
+            if isinstance(node, ast.Call) and _tail(_dotted(node.func)) in (
+                "set", "frozenset"
+            ):
+                return True
+            if isinstance(node, ast.Name):
+                return node.id in local_sets
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+            ):
+                return is_set_expr(node.left, local_sets) or is_set_expr(
+                    node.right, local_sets
+                )
+            return False
+
+        def scan(walker) -> None:
+            nodes = list(walker)
+            local_sets: set[str] = set()
+            assigns = [
+                node
+                for node in nodes
+                if isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ]
+            # The walkers are stack-based (reverse order); fold assignments
+            # in SOURCE order so `ready = sorted(ready)` discards the
+            # earlier `ready = set(...)` binding, not the other way round.
+            for node in sorted(assigns, key=lambda n: (n.lineno, n.col_offset)):
+                if is_set_expr(node.value, local_sets):
+                    local_sets.add(node.targets[0].id)
+                else:
+                    local_sets.discard(node.targets[0].id)
+            for node in nodes:
+                iters: list[ast.AST] = []
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    iters.append(node.iter)
+                elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+                    iters.extend(g.iter for g in node.generators)
+                for it in iters:
+                    if is_set_expr(it, local_sets):
+                        what = (
+                            f"`{it.id}`" if isinstance(it, ast.Name) else "a set expression"
+                        )
+                        self.add(
+                            node, "GC006",
+                            f"iteration over unordered set {what} in serving code",
+                            hint,
+                        )
+
+        scan(self.mod.module_own_walk())
+        for f in self.mod.funcs:
+            scan(_own_walk(f.node))
+
+    # ------------------------------------------------------------- GC007
+    def check_gc007(self) -> None:
+        hint = (
+            "serving results must be bitwise schedule-invariant: use "
+            "router.stable_hash for hashing, an injected logical clock for time, "
+            "and keys derived from the engine's base_key for randomness"
+        )
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name) and node.func.id == "hash":
+                self.add(
+                    node, "GC007",
+                    "builtin `hash()` is salted per process (PYTHONHASHSEED) — "
+                    "placement keyed on it reshuffles every restart",
+                    hint,
+                )
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None:
+                continue
+            if dotted in _NONDET_DOTTED:
+                self.add(node, "GC007", f"`{dotted}`: {_NONDET_DOTTED[dotted]}", hint)
+                continue
+            root = dotted.split(".")[0]
+            if root in _NONDET_MODULE_ROOTS and "." in dotted:
+                self.add(node, "GC007", f"`{dotted}`: {_NONDET_MODULE_ROOTS[root]}", hint)
+
+    # ------------------------------------------------------------- GC008
+    def check_gc008(self) -> None:
+        hint = (
+            "route block alloc/free through the sanctioned owners "
+            "(_plan_admission_tables, _free_slot_blocks, reset) so every alloc "
+            "has exactly one paired release; serving.sanitizer verifies the "
+            "pairing at runtime"
+        )
+
+        def is_allocator(node: ast.AST, aliases: set[str]) -> bool:
+            if isinstance(node, ast.Attribute):
+                return node.attr == "_block_alloc"
+            if isinstance(node, ast.Name):
+                return node.id in aliases or node.id == "_block_alloc"
+            return False
+
+        def scan(body: list[ast.stmt], cls_name: str | None, fn_name: str | None) -> None:
+            sanctioned = (
+                (cls_name is not None and "Allocator" in cls_name)
+                or fn_name in _LEDGER_OWNER_FUNCS
+            )
+            aliases: set[str] = set()
+            stack = list(body)
+            nodes: list[ast.AST] = []
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan(n.body, cls_name if fn_name is None else None, n.name)
+                    continue
+                if isinstance(n, ast.ClassDef):
+                    scan(n.body, n.name, None)
+                    continue
+                nodes.append(n)
+                stack.extend(ast.iter_child_nodes(n))
+            for n in nodes:
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 and isinstance(
+                    n.targets[0], ast.Name
+                ):
+                    if is_allocator(n.value, aliases):
+                        aliases.add(n.targets[0].id)
+            if sanctioned:
+                return
+            for n in nodes:
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _LEDGER_METHODS
+                    and is_allocator(n.func.value, aliases)
+                ):
+                    where = f" in `{fn_name}`" if fn_name else ""
+                    self.add(
+                        n, "GC008",
+                        f"block ledger call `.{n.func.attr}()`{where} outside the "
+                        "sanctioned owners",
+                        hint,
+                    )
+                elif (
+                    isinstance(n, ast.Attribute)
+                    and n.attr in _LEDGER_INTERNALS
+                    and is_allocator(n.value, aliases)
+                ):
+                    where = f" in `{fn_name}`" if fn_name else ""
+                    self.add(
+                        n, "GC008",
+                        f"direct touch of allocator internal `.{n.attr}`{where} — "
+                        "the free list and refcounts belong to the allocator",
+                        hint,
+                    )
+
+        scan(list(ast.iter_child_nodes(self.tree)), None, None)
 
     # ------------------------------------------------------------- GC005
     def check_gc005(self) -> None:

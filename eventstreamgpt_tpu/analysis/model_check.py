@@ -1,0 +1,1144 @@
+"""graftcheck Tier D — the serving control-plane model checker.
+
+A bounded exhaustive-interleaving explorer that drives the REAL
+`Scheduler`/`GenerationEngine`/`ServingService`/`ServingFleet` objects
+(tiny widths, CPU) through every schedule of enabled control-plane
+actions — admit, plan, issue_chunk, resolve_chunk, fork, deadline fire,
+evict+replay, promote arm/advance — up to a depth bound, checking the
+serving invariant oracles at every reached state:
+
+* block-pool refcount conservation (no leak, no double-free, the zero
+  block never freed, pool empty after reset) — `serving.sanitizer`
+* the fleet's zero-drop physical ledger and session-affinity stability
+* the slot-epoch stale-boundary guard and harvest-once
+* strict-FIFO boundary resolution and contiguous chunk issue
+* one-time, monotonic `fold_in` admission-index binding
+* **determinism**: every explored schedule, canonically drained, must
+  produce results bitwise identical per admission index to the reference
+  serial drain — the repo's placement/chunking/depth/eviction/fork
+  invariance contract, checked across ALL interleavings instead of the
+  handful the e2e suites pick.
+
+Tractability comes from sleep-set partial-order reduction: each action
+declares a *resource set*, two actions are independent iff their
+resource sets are disjoint, and a schedule that only reorders independent
+actions is explored once. Soundness (docs/analysis.md "Tier D") rests on
+the declared-disjoint pairs genuinely commuting on every reachable state
+of the scenario — resource sets here are deliberately coarse (whole
+engine, whole service) except where the commutation argument is written
+down.
+
+Violations shrink to a minimal failing schedule by greedy delta
+debugging (drop one action at a time, keep the shortest still-failing
+schedule) before being reported — the reproduction a human debugs.
+
+Exploration is replay-based: `Scenario.build()` constructs the engines
+ONCE (their jit caches survive `reset()`, so replays never recompile)
+and `Scenario.reset()` rewinds the control-plane state — rebuilding the
+cheap service/fleet wrappers around the same engines — before each
+schedule prefix is re-applied. Counts are deterministic: `enabled()`
+returns actions in sorted order, the DFS visits them in that order, and
+the committed `MODELCHECK.json` pins the per-scenario schedule counts
+byte-reproducibly (the MEMORY.json discipline).
+
+The caller provisions the CPU mesh (tests/conftest.py or graftcheck's
+`_provision_mesh`) before anything here touches jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+__all__ = [
+    "Action",
+    "Scenario",
+    "Explorer",
+    "ScenarioReport",
+    "SCENARIOS",
+    "run_scenario",
+    "run_all",
+]
+
+
+# --------------------------------------------------------------------------
+# Explorer core (pure Python — unit-testable without jax)
+# --------------------------------------------------------------------------
+
+
+class Action:
+    """One enabled control-plane action: a stable name (the schedule
+    alphabet) plus the resource set the POR independence relation reads —
+    two actions commute iff their resources are disjoint."""
+
+    __slots__ = ("name", "resources")
+
+    def __init__(self, name: str, resources: Iterable[str]):
+        self.name = name
+        self.resources = frozenset(resources)
+
+    def __repr__(self):
+        return f"Action({self.name!r}, {sorted(self.resources)})"
+
+
+class Scenario:
+    """One model-checking scenario over real serving objects.
+
+    Subclasses implement:
+
+    * ``build()`` — construct engines/params ONCE (jit caches persist).
+    * ``reset()`` — rewind to the initial control-plane state; called
+      before every schedule replay. Wrappers (service/fleet) are cheap
+      and rebuilt here; engines are `engine.reset()`.
+    * ``enabled()`` — the currently enabled actions. MUST be
+      deterministic, and every action that binds a PRNG key (admit,
+      submit, fork) MUST be sequentially enabled in one fixed order so
+      the admitted set's keys are schedule-invariant — interleaving
+      freedom lives in WHERE the bindings fall relative to dispatch, not
+      in their order.
+    * ``apply(name)`` — perform one action.
+    * ``invariants()`` — violation messages for the CURRENT state
+      (sanitizer logs + conservation/ledger checks); checked by the
+      explorer after reset, after every action, and after the drain.
+    * ``drain()`` — run the canonical serial completion from the current
+      state and return ``{key: outcome}`` where outcome is
+      ``("ok", ...content digest...)`` or ``("error:<Type>",)``. Must be
+      a deterministic function of the applied schedule.
+
+    ``allowed_errors`` names error outcomes the determinism oracle
+    accepts instead of content (e.g. ``DeadlineExceeded`` — an expired
+    request returns no content by contract; its index stays burned).
+    """
+
+    name: str = "scenario"
+    depth: int = 8
+    max_schedules: Optional[int] = None
+    allowed_errors: frozenset = frozenset()
+
+    def build(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def enabled(self) -> list[Action]:
+        raise NotImplementedError
+
+    def apply(self, name: str) -> None:
+        raise NotImplementedError
+
+    def invariants(self) -> list[str]:
+        return []
+
+    def drain(self) -> dict:
+        raise NotImplementedError
+
+
+class _InvalidSchedule(Exception):
+    """A shrink candidate replayed an action that was not enabled at its
+    point — the candidate is discarded, not a violation."""
+
+
+class ScenarioReport:
+    """Result of exploring one scenario."""
+
+    def __init__(self, name: str, depth: int):
+        self.name = name
+        self.depth = depth
+        self.schedules = 0
+        self.actions: set[str] = set()
+        self.violations: list[dict] = []
+        self.truncated = False
+
+    def to_dict(self) -> dict:
+        return {
+            "scenario": self.name,
+            "depth": self.depth,
+            "schedules": self.schedules,
+            "actions": sorted(self.actions),
+            "truncated": self.truncated,
+            "violations": self.violations,
+        }
+
+
+class Explorer:
+    """Sleep-set DFS over a scenario's schedules.
+
+    Stops at the FIRST violation (after shrinking it to a minimal failing
+    schedule) — one actionable reproduction beats a thousand duplicates
+    of the same bug. ``max_schedules`` caps the leaf count; with the cap
+    hit the count is still deterministic (sorted DFS order)."""
+
+    def __init__(self, scenario: Scenario, max_schedules: Optional[int] = None):
+        self.scenario = scenario
+        self.max_schedules = (
+            max_schedules if max_schedules is not None else scenario.max_schedules
+        )
+        self.report = ScenarioReport(scenario.name, scenario.depth)
+        self._stop = False
+        self._reference: Optional[dict] = None
+
+    # ------------------------------------------------------------ plumbing
+    def _apply_checked(self, name: str) -> list[str]:
+        """Applies one action; any exception or invariant breach is the
+        violation message list (empty = clean)."""
+        try:
+            self.scenario.apply(name)
+        except Exception as e:  # noqa: BLE001 — every escape IS the finding
+            return [f"{type(e).__name__} applying {name!r}: {e}"]
+        return self.scenario.invariants()
+
+    def _safe_drain(self) -> tuple[Optional[dict], list[str]]:
+        try:
+            outcome = self.scenario.drain()
+        except Exception as e:  # noqa: BLE001
+            return None, [f"{type(e).__name__} during canonical drain: {e}"]
+        msgs = self.scenario.invariants()
+        return outcome, msgs
+
+    def _reset_checked(self) -> list[str]:
+        self.scenario.reset()
+        return self.scenario.invariants()
+
+    def _compare(self, outcome: dict) -> list[str]:
+        """The determinism oracle: per-key outcomes vs the reference
+        serial drain. ``allowed_errors`` outcomes pass without content
+        (their keys must still be present — a silent drop never passes)."""
+        ref = self._reference
+        msgs = []
+        for k in sorted(set(ref) | set(outcome), key=repr):
+            a, b = ref.get(k), outcome.get(k)
+            if b is None:
+                msgs.append(f"request {k!r} completed in the reference but "
+                            "not in this schedule (dropped)")
+                continue
+            if a is None:
+                msgs.append(f"request {k!r} completed in this schedule but "
+                            "not in the reference")
+                continue
+            if b[0] != "ok":
+                kind = b[0].split(":", 1)[1] if ":" in b[0] else b[0]
+                if kind not in self.scenario.allowed_errors:
+                    msgs.append(f"request {k!r} failed with {kind} "
+                                "(not an allowed outcome for this scenario)")
+                continue
+            if a != b:
+                msgs.append(
+                    f"request {k!r} diverged from the reference drain: "
+                    f"{a} != {b} — results must be bitwise invariant to "
+                    "the control-plane schedule"
+                )
+        return msgs
+
+    def _replay(self, schedule: list[str]) -> None:
+        """Rewinds and re-applies ``schedule`` (known-clean prefix)."""
+        self.scenario.reset()
+        for name in schedule:
+            self.scenario.apply(name)
+
+    def _fails(self, schedule: list[str]) -> bool:
+        """Shrink predicate: does ``schedule`` (replayed from reset, then
+        canonically drained) produce a violation? Invalid schedules (an
+        action not enabled at its point) are not failures."""
+        msgs = self._reset_checked()
+        if msgs:
+            return True
+        for name in schedule:
+            if name not in {a.name for a in self.scenario.enabled()}:
+                raise _InvalidSchedule(name)
+            msgs = self._apply_checked(name)
+            if msgs:
+                return True
+        outcome, msgs = self._safe_drain()
+        if msgs:
+            return True
+        return bool(self._reference is not None and self._compare(outcome))
+
+    def _shrink(self, schedule: list[str]) -> list[str]:
+        cur = list(schedule)
+        changed = True
+        while changed:
+            changed = False
+            for i in range(len(cur)):
+                cand = cur[:i] + cur[i + 1 :]
+                try:
+                    if self._fails(cand):
+                        cur = cand
+                        changed = True
+                        break
+                except _InvalidSchedule:
+                    continue
+        return cur
+
+    def _violate(self, schedule: list[str], messages: list[str]) -> None:
+        minimal = self._shrink(schedule)
+        self.report.violations.append(
+            {
+                "schedule": list(schedule),
+                "minimal": minimal,
+                "messages": list(messages),
+            }
+        )
+        self._stop = True
+
+    # ---------------------------------------------------------- exploration
+    def run(self) -> ScenarioReport:
+        msgs = self._reset_checked()
+        if msgs:
+            self._violate([], msgs)
+            return self.report
+        self._reference, msgs = self._safe_drain()
+        if msgs:
+            self._reference = None
+            self._violate([], msgs)
+            return self.report
+        self._replay([])
+        self._dfs([], {})
+        return self.report
+
+    def _leaf(self, schedule: list[str]) -> None:
+        self.report.schedules += 1
+        outcome, msgs = self._safe_drain()
+        if msgs:
+            self._violate(schedule, msgs)
+            return
+        msgs = self._compare(outcome)
+        if msgs:
+            self._violate(schedule, msgs)
+            return
+        if (
+            self.max_schedules is not None
+            and self.report.schedules >= self.max_schedules
+        ):
+            self.report.truncated = True
+            self._stop = True
+
+    def _dfs(self, schedule: list[str], sleep: dict[str, frozenset]) -> None:
+        """``schedule`` is applied to the live state on entry. ``sleep``
+        maps action name -> resources for actions whose exploration here
+        would only commute into an already-explored schedule."""
+        if self._stop:
+            return
+        enabled = sorted(self.scenario.enabled(), key=lambda a: a.name)
+        self.report.actions.update(a.name for a in enabled)
+        candidates = [a for a in enabled if a.name not in sleep]
+        if len(schedule) >= self.scenario.depth or not candidates:
+            self._leaf(schedule)
+            return
+        done: list[Action] = []
+        for act in candidates:
+            if self._stop:
+                return
+            self._replay(schedule)
+            msgs = self._apply_checked(act.name)
+            if msgs:
+                self._violate(schedule + [act.name], msgs)
+                return
+            carried = {**sleep, **{d.name: d.resources for d in done}}
+            child_sleep = {
+                n: r for n, r in carried.items() if not (act.resources & r)
+            }
+            self._dfs(schedule + [act.name], child_sleep)
+            done.append(act)
+
+
+# --------------------------------------------------------------------------
+# The tiny CI model (in-package replica of the test-suite recipe)
+# --------------------------------------------------------------------------
+
+_CI_SETUP = None
+
+
+def _tiny_config():
+    from ..data.config import MeasurementConfig
+    from ..models.config import StructuredTransformerConfig
+
+    # Vocab: event_type [1, 4), multi_lab [4, 8), lab_vals [8, 12) — the
+    # CI-width config the fast serving suites build (tests/test_generation).
+    measurement_configs = {
+        "multi_lab": MeasurementConfig(
+            name="multi_lab",
+            temporality="dynamic",
+            modality="multi_label_classification",
+        ),
+        "lab_vals": MeasurementConfig(
+            name="lab_vals",
+            temporality="dynamic",
+            modality="multivariate_regression",
+            values_column="v",
+        ),
+    }
+    return StructuredTransformerConfig(
+        measurement_configs=measurement_configs,
+        vocab_sizes_by_measurement={"event_type": 3, "multi_lab": 4, "lab_vals": 4},
+        vocab_offsets_by_measurement={"event_type": 1, "multi_lab": 4, "lab_vals": 8},
+        measurements_idxmap={"event_type": 1, "multi_lab": 2, "lab_vals": 3},
+        measurements_per_generative_mode={
+            "single_label_classification": ["event_type"],
+            "multi_label_classification": ["multi_lab", "lab_vals"],
+            "multivariate_regression": ["lab_vals"],
+        },
+        max_seq_len=12,
+        hidden_size=16,
+        head_dim=4,
+        num_attention_heads=4,
+        num_hidden_layers=2,
+        intermediate_size=16,
+        seq_attention_types="global",
+    )
+
+
+def _make_prompt(B=2, L=3, M=6, seed=0):
+    import jax.numpy as jnp
+
+    from ..data.types import EventStreamBatch
+
+    rng = np.random.default_rng(seed)
+    dyn_meas = np.zeros((B, L, M), dtype=np.int64)
+    dyn_idx = np.zeros((B, L, M), dtype=np.int64)
+    dyn_vals = np.zeros((B, L, M), dtype=np.float32)
+    dyn_vmask = np.zeros((B, L, M), dtype=bool)
+    for b in range(B):
+        for l in range(L):
+            dyn_meas[b, l, 0] = 1
+            dyn_idx[b, l, 0] = rng.integers(1, 4)
+            dyn_meas[b, l, 1] = 2
+            dyn_idx[b, l, 1] = rng.integers(4, 8)
+            dyn_meas[b, l, 2] = 3
+            dyn_idx[b, l, 2] = rng.integers(8, 12)
+            dyn_vals[b, l, 2] = rng.normal()
+            dyn_vmask[b, l, 2] = True
+    return EventStreamBatch(
+        event_mask=jnp.ones((B, L), dtype=bool),
+        time_delta=jnp.asarray(rng.uniform(0.5, 10.0, size=(B, L)).astype(np.float32)),
+        start_time=jnp.zeros((B,), dtype=jnp.float32),
+        static_indices=jnp.asarray(rng.integers(1, 12, size=(B, 2))),
+        static_measurement_indices=jnp.asarray(np.ones((B, 2), dtype=np.int64)),
+        dynamic_indices=jnp.asarray(dyn_idx),
+        dynamic_measurement_indices=jnp.asarray(dyn_meas),
+        dynamic_values=jnp.asarray(dyn_vals),
+        dynamic_values_mask=jnp.asarray(dyn_vmask),
+    )
+
+
+def _ci_setup():
+    """(config, model, params, template) — built once per process; every
+    scenario's engines share the weights, so compile caches amortize."""
+    global _CI_SETUP
+    if _CI_SETUP is None:
+        import jax
+
+        from ..models.ci_model import CIPPTForGenerativeSequenceModeling
+
+        config = _tiny_config()
+        template = _make_prompt(B=4, L=4)
+        model = CIPPTForGenerativeSequenceModeling(config)
+        params = model.init(jax.random.PRNGKey(0), template)
+        _CI_SETUP = (config, model, params, template)
+    return _CI_SETUP
+
+
+def _build_engine(**kw):
+    import jax
+
+    from ..serving.engine import GenerationEngine
+
+    config, model, params, template = _ci_setup()
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 8)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("min_bucket", 2)
+    kw.setdefault("base_key", jax.random.PRNGKey(7))
+    return GenerationEngine(model, params, config, template=template, **kw)
+
+
+def _digest(batch) -> Optional[str]:
+    """Stable content digest of a result batch (bitwise: raw array bytes)."""
+    if batch is None:
+        return None
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(batch):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _outcome(result) -> tuple:
+    """Schedule-invariant summary of one Engine/Service/FleetResult."""
+    err = getattr(result, "error", None)
+    if err is not None:
+        return (f"error:{type(err).__name__}",)
+    return (
+        "ok",
+        result.prompt_len,
+        result.n_events,
+        result.n_generated,
+        _digest(result.batch),
+    )
+
+
+# --------------------------------------------------------------------------
+# Engine-level scenarios
+# --------------------------------------------------------------------------
+
+
+class _EngineScenario(Scenario):
+    """Shared machinery: one paged engine, N sequentially-admitted
+    requests, the admit/plan/issue/resolve action alphabet.
+
+    Resource sets (the commutation argument, docs/analysis.md):
+    ``admit`` touches only the scheduler queue; ``plan`` consumes the
+    queue AND admits into slots/device state; ``issue`` advances device
+    state and appends to the in-flight deque; ``resolve`` pops the deque
+    and harvests slots. ``admit`` therefore commutes with ``issue`` and
+    ``resolve`` (disjoint state; harvest never touches the queue in
+    these scenarios — the health sentinel cannot fire on finite CI
+    weights), and every other pair conflicts."""
+
+    n_requests = 3
+    max_new = 3
+    engine_kw: dict = {}
+
+    def build(self) -> None:
+        self.eng = _build_engine(paged_kv=True, block_size=4, **self.engine_kw)
+        from ..serving.sanitizer import attach_sanitizer
+
+        self.san = attach_sanitizer(self.eng)
+        self._prompts = [_make_prompt(B=1, L=2, seed=10 + i) for i in range(self.n_requests)]
+
+    def _fresh_requests(self) -> list:
+        from ..serving.scheduler import Request
+
+        return [
+            Request(prompt=p, max_new_events=self.max_new, request_id=f"r{i}")
+            for i, p in enumerate(self._prompts)
+        ]
+
+    def reset(self) -> None:
+        self.eng.reset()
+        self.requests = self._fresh_requests()
+        self.submitted = 0
+        self.results: dict[int, tuple] = {}
+
+    def enabled(self) -> list[Action]:
+        acts = []
+        if self.submitted < len(self.requests):
+            acts.append(Action(f"admit{self.submitted}", {"queue"}))
+        if self.eng.scheduler.pending and self.eng.free_slots():
+            acts.append(Action("plan", {"queue", "slots", "device"}))
+        if self.eng.occupied and self.eng.inflight_chunks < self.eng.dispatch_depth:
+            acts.append(Action("issue", {"device", "inflight"}))
+        if self.eng.inflight_chunks:
+            acts.append(Action("resolve", {"slots", "device", "inflight"}))
+        return acts
+
+    def apply(self, name: str) -> None:
+        if name.startswith("admit"):
+            self.eng.submit(self.requests[self.submitted])
+            self.submitted += 1
+        elif name == "plan":
+            self.eng.plan_and_dispatch()
+        elif name == "issue":
+            self.eng.issue_chunk()
+        elif name == "resolve":
+            self._record(self.eng.resolve_chunk(0.0, True))
+        else:
+            raise KeyError(name)
+
+    def _record(self, results) -> None:
+        for r in results:
+            key = r.admission_index
+            if key in self.results:
+                raise AssertionError(
+                    f"admission index {key} completed twice (stale-boundary "
+                    "double harvest)"
+                )
+            self.results[key] = _outcome(r)
+
+    def invariants(self) -> list[str]:
+        msgs = list(self.san.violations)
+        msgs += self.san.check()
+        if (
+            self.eng._dispatched_chunks - self.eng._resolved_chunks
+            != self.eng.inflight_chunks
+        ):
+            msgs.append(
+                "in-flight accounting desynced: dispatched "
+                f"{self.eng._dispatched_chunks} - resolved "
+                f"{self.eng._resolved_chunks} != {self.eng.inflight_chunks} queued"
+            )
+        return msgs
+
+    def drain(self) -> dict:
+        guard = 0
+        while (
+            self.submitted < len(self.requests)
+            or self.eng.scheduler.pending
+            or self.eng.occupied
+            or self.eng.inflight_chunks
+        ):
+            guard += 1
+            if guard > 500:
+                raise RuntimeError("drain did not converge in 500 rounds")
+            while self.submitted < len(self.requests):
+                self.eng.submit(self.requests[self.submitted])
+                self.submitted += 1
+            if self.eng.scheduler.pending and self.eng.free_slots():
+                self.eng.plan_and_dispatch()
+            if self.eng.occupied and self.eng.inflight_chunks < self.eng.dispatch_depth:
+                self.eng.issue_chunk()
+            elif self.eng.inflight_chunks:
+                self._record(self.eng.resolve_chunk(0.0, True))
+        return dict(self.results)
+
+
+class EnginePipelineScenario(_EngineScenario):
+    """Continuous batching under pipelined dispatch: 4 requests through a
+    2-slot paged engine at dispatch depth 2 — every interleaving of
+    admission, group prefill, chunk issue, and boundary resolution."""
+
+    name = "engine_pipeline"
+    depth = 14
+    max_schedules = 800
+    n_requests = 4
+    max_new = 4
+    engine_kw = dict(n_slots=2, dispatch_depth=2)
+
+
+class EngineRecycleScenario(_EngineScenario):
+    """Slot recycling under stale pipelined boundaries: 1 slot, depth-2
+    pipelining, 4 tenants in sequence — the scenario whose boundaries
+    predate re-admissions, exercising the `_slot_epoch` guard."""
+
+    name = "engine_recycle"
+    depth = 16
+    max_schedules = 800
+    n_requests = 5
+    max_new = 4
+    engine_kw = dict(n_slots=1, dispatch_depth=2)
+
+
+class ForkCowScenario(_EngineScenario):
+    """Copy-on-write fork vs plain traffic: a 2-branch fork group (one
+    prefill, shared refcounted prefix blocks) interleaved with two plain
+    requests on a 3-slot paged engine. Key bindings stay schedule-
+    invariant by sequential enabling: fork first, then the plain admits."""
+
+    name = "fork_cow"
+    depth = 14
+    max_schedules = 800
+    n_plain = 2
+    engine_kw = dict(n_slots=3, dispatch_depth=2)
+
+    def build(self) -> None:
+        self.eng = _build_engine(paged_kv=True, block_size=4, **self.engine_kw)
+        from ..serving.sanitizer import attach_sanitizer
+
+        self.san = attach_sanitizer(self.eng)
+        self._fork_prompt = _make_prompt(B=1, L=4, seed=21)
+        self._plain_prompts = [
+            _make_prompt(B=1, L=2, seed=22 + i) for i in range(self.n_plain)
+        ]
+
+    def reset(self) -> None:
+        self.eng.reset()
+        from ..serving.scheduler import Request
+
+        self._plain = [
+            Request(prompt=p, max_new_events=4, request_id=f"plain{i}")
+            for i, p in enumerate(self._plain_prompts)
+        ]
+        self.forked = False
+        self.admitted_plain = 0
+        self.results = {}
+
+    def enabled(self) -> list[Action]:
+        acts = []
+        if not self.forked:
+            acts.append(Action("fork", {"queue"}))
+        elif self.admitted_plain < len(self._plain):
+            acts.append(Action(f"admit_plain{self.admitted_plain}", {"queue"}))
+        if self.eng.scheduler.pending and self.eng.free_slots():
+            acts.append(Action("plan", {"queue", "slots", "device"}))
+        if self.eng.occupied and self.eng.inflight_chunks < self.eng.dispatch_depth:
+            acts.append(Action("issue", {"device", "inflight"}))
+        if self.eng.inflight_chunks:
+            acts.append(Action("resolve", {"slots", "device", "inflight"}))
+        return acts
+
+    def apply(self, name: str) -> None:
+        if name == "fork":
+            self.eng.fork(self._fork_prompt, 2, 3, request_id="branch")
+            self.forked = True
+        elif name.startswith("admit_plain"):
+            self.eng.submit(self._plain[self.admitted_plain])
+            self.admitted_plain += 1
+        else:
+            super().apply(name)
+
+    def drain(self) -> dict:
+        guard = 0
+        while (
+            not self.forked
+            or self.admitted_plain < len(self._plain)
+            or self.eng.scheduler.pending
+            or self.eng.occupied
+            or self.eng.inflight_chunks
+        ):
+            guard += 1
+            if guard > 500:
+                raise RuntimeError("drain did not converge in 500 rounds")
+            if not self.forked:
+                self.apply("fork")
+            while self.admitted_plain < len(self._plain):
+                self.apply(f"admit_plain{self.admitted_plain}")
+            if self.eng.scheduler.pending and self.eng.free_slots():
+                self.eng.plan_and_dispatch()
+            if self.eng.occupied and self.eng.inflight_chunks < self.eng.dispatch_depth:
+                self.eng.issue_chunk()
+            elif self.eng.inflight_chunks:
+                self._record(self.eng.resolve_chunk(0.0, True))
+        return dict(self.results)
+
+
+# --------------------------------------------------------------------------
+# Service-level scenario (deadline lanes, per-replica pump)
+# --------------------------------------------------------------------------
+
+
+class ServiceDeadlineScenario(Scenario):
+    """A 2-replica service with a deadline lane, decomposed to per-replica
+    granularity: submit/place/tick/harvest plus a logical-clock jump that
+    fires the lane deadline on whatever is still queued.
+
+    Resource sets: ``submit``/``expire`` own the lanes; ``place`` owns
+    lanes + both replicas (it may place onto either, keyed by outstanding
+    budget a harvest changes); ``tick{r}``/``harvest{r}`` own replica r
+    only — rounds on distinct replicas commute (disjoint engines, result
+    records keyed by admission index, `_outstanding` entries disjoint)."""
+
+    name = "service_deadline"
+    depth = 14
+    max_schedules = 800
+    n_requests = 4
+    allowed_errors = frozenset({"DeadlineExceeded"})
+
+    def build(self) -> None:
+        from ..serving.sanitizer import attach_sanitizer
+
+        self.engines = [
+            _build_engine(paged_kv=True, block_size=4, n_slots=1, dispatch_depth=1)
+            for _ in range(2)
+        ]
+        self.sans = [attach_sanitizer(e) for e in self.engines]
+        self._prompts = [_make_prompt(B=1, L=2, seed=30 + i) for i in range(self.n_requests)]
+
+    def reset(self) -> None:
+        import jax
+
+        from ..serving.scheduler import Request
+        from ..serving.service import ServingService
+        from ..serving.slo import LaneConfig
+
+        for e in self.engines:
+            e.reset()
+        self.svc = ServingService(
+            self.engines,
+            lanes=(LaneConfig("rt", deadline_s=5.0),),
+            default_lane="rt",
+            base_key=jax.random.PRNGKey(11),
+        )
+        self.requests = [
+            Request(prompt=p, max_new_events=3, request_id=f"q{i}")
+            for i, p in enumerate(self._prompts)
+        ]
+        self.submitted = 0
+        self.now = 0.0
+        self.expired_fired = False
+        self.results: dict[int, tuple] = {}
+
+    def enabled(self) -> list[Action]:
+        acts = []
+        if self.submitted < len(self.requests):
+            acts.append(Action(f"submit{self.submitted}", {"lanes"}))
+        if self.svc.lanes.pending:
+            acts.append(Action("place", {"lanes", "r0", "r1"}))
+            if not self.expired_fired:
+                acts.append(Action("expire", {"lanes"}))
+        for ri, eng in enumerate(self.engines):
+            if (eng.scheduler.pending and eng.free_slots()) or (
+                eng.occupied and eng.inflight_chunks < eng.dispatch_depth
+            ):
+                acts.append(Action(f"tick{ri}", {f"r{ri}"}))
+            if eng.inflight_chunks:
+                acts.append(Action(f"harvest{ri}", {f"r{ri}"}))
+        return acts
+
+    def _record(self, service_results) -> None:
+        for sr in service_results:
+            key = sr.admission_index
+            if key in self.results:
+                raise AssertionError(f"service index {key} completed twice")
+            self.results[key] = _outcome(sr)
+
+    def apply(self, name: str) -> None:
+        if name.startswith("submit"):
+            accepted = self.svc.submit(self.requests[self.submitted])
+            assert accepted  # the lane is unbounded in this scenario
+            self.submitted += 1
+        elif name == "place":
+            self.svc._place()
+        elif name == "expire":
+            # The logical clock jumps past the lane deadline; everything
+            # still QUEUED cancels with a typed DeadlineExceeded. Placed
+            # and resident work is exempt by contract.
+            self.now = 11.0
+            self.expired_fired = True
+            self._record(self.svc._expire(self.now))
+        elif name.startswith("tick"):
+            eng = self.engines[int(name[4:])]
+            if eng.scheduler.pending and eng.free_slots():
+                eng.plan_and_dispatch()
+            if eng.occupied and eng.inflight_chunks < eng.dispatch_depth:
+                eng.issue_chunk()
+        elif name.startswith("harvest"):
+            ri = int(name[7:])
+            eng = self.engines[ri]
+            self._record(
+                self.svc._wrap(er, ri) for er in eng.resolve_chunk(self.now, True)
+            )
+        else:
+            raise KeyError(name)
+
+    def invariants(self) -> list[str]:
+        from ..serving.sanitizer import check_block_pool
+
+        msgs = []
+        for ri, (eng, san) in enumerate(zip(self.engines, self.sans)):
+            msgs += [f"replica {ri}: {m}" for m in san.violations]
+            msgs += [f"replica {ri}: {m}" for m in check_block_pool(eng)]
+        # The service-level zero-drop scoreboard: accepted == returned +
+        # still physically somewhere (lane, engine queue, or resident).
+        if self.svc._next_index != len(self.results) + len(self.svc._meta):
+            msgs.append(
+                f"service ledger desynced: {self.svc._next_index} accepted != "
+                f"{len(self.results)} returned + {len(self.svc._meta)} in flight"
+            )
+        return msgs
+
+    def drain(self) -> dict:
+        guard = 0
+        while self.submitted < len(self.requests) or self.svc._meta:
+            guard += 1
+            if guard > 500:
+                raise RuntimeError("drain did not converge in 500 rounds")
+            while self.submitted < len(self.requests):
+                self.apply(f"submit{self.submitted}")
+            self._record(self.svc._expire(self.now))
+            self.svc._place()
+            for ri, eng in enumerate(self.engines):
+                if eng.scheduler.pending and eng.free_slots():
+                    eng.plan_and_dispatch()
+                if eng.occupied and eng.inflight_chunks < eng.dispatch_depth:
+                    eng.issue_chunk()
+                if eng.inflight_chunks and (
+                    eng.inflight_chunks >= eng.dispatch_depth or not eng.occupied
+                ):
+                    self._record(
+                        self.svc._wrap(er, ri)
+                        for er in eng.resolve_chunk(self.now, True)
+                    )
+        return dict(self.results)
+
+
+# --------------------------------------------------------------------------
+# Fleet-level scenarios (eviction + replay, promotion hold/flip)
+# --------------------------------------------------------------------------
+
+
+class _FleetScenario(Scenario):
+    """Shared machinery: a 2-service fleet (1 paged replica each), traffic
+    from subjects chosen so BOTH services own sessions, per-service
+    `step` actions at the granularity `ServingFleet.run` uses.
+
+    Resource sets: ``step{sid}`` owns service sid only — steps of
+    distinct services commute (disjoint engines and `_meta` keys; the
+    shared accepted/completed counters only ever increment, and results
+    are recorded by fleet index, not arrival order). ``submit`` owns its
+    routed service plus the ring ("router"); eviction and promotion
+    advancement own everything they might touch."""
+
+    engine_kw: dict = {}
+    n_requests = 4
+
+    def build(self) -> None:
+        from ..serving.router import ConsistentHashRouter
+        from ..serving.sanitizer import attach_sanitizer
+
+        self.engines = {
+            sid: _build_engine(
+                paged_kv=True, block_size=4, n_slots=2, dispatch_depth=1,
+                **self.engine_kw,
+            )
+            for sid in ("s0", "s1")
+        }
+        self.sans = {sid: attach_sanitizer(e) for sid, e in self.engines.items()}
+        # Subjects picked off the real ring so each service owns two.
+        ring = ConsistentHashRouter(["s0", "s1"])
+        per_sid: dict[str, list[str]] = {"s0": [], "s1": []}
+        for i in range(64):
+            sub = f"u{i}"
+            sid = ring.route(sub)
+            if len(per_sid[sid]) < self.n_requests // 2:
+                per_sid[sid].append(sub)
+            if all(len(v) >= self.n_requests // 2 for v in per_sid.values()):
+                break
+        # Interleave ownership so submission order alternates services.
+        self.subjects = [
+            s for pair in zip(per_sid["s0"], per_sid["s1"]) for s in pair
+        ]
+        self._prompts = [
+            _make_prompt(B=1, L=2, seed=40 + i) for i in range(self.n_requests)
+        ]
+
+    def _build_fleet(self):
+        import jax
+
+        from ..serving.fleet import ServingFleet
+        from ..serving.service import ServingService
+
+        for e in self.engines.values():
+            e.reset()
+        self.services = {
+            sid: ServingService([e], base_key=jax.random.PRNGKey(13))
+            for sid, e in self.engines.items()
+        }
+        self.fleet = ServingFleet(
+            dict(self.services), base_key=jax.random.PRNGKey(17)
+        )
+
+    def reset(self) -> None:
+        from ..serving.scheduler import Request
+
+        self._build_fleet()
+        self.requests = [
+            Request(prompt=p, max_new_events=3, request_id=f"f{i}")
+            for i, p in enumerate(self._prompts)
+        ]
+        self.submitted = 0
+        self.results: dict[int, tuple] = {}
+
+    # --------------------------------------------------------- shared ops
+    def _submit_next(self) -> None:
+        sub = self.subjects[self.submitted]
+        accepted = self.fleet.submit(sub, self.requests[self.submitted])
+        assert accepted  # default lanes are unbounded
+        self.submitted += 1
+
+    def _step(self, sid: str) -> None:
+        svc = self.fleet.services[sid]
+        for sr in svc.step(lambda: 0.0, True, place=True):
+            fr = self.fleet._wrap(sr, sid)
+            key = fr.fleet_index
+            if key in self.results:
+                raise AssertionError(f"fleet index {key} completed twice")
+            self.results[key] = _outcome(fr)
+
+    def _route_of_next(self) -> str:
+        return self.fleet.route(self.subjects[self.submitted])
+
+    def invariants(self) -> list[str]:
+        from ..serving.sanitizer import check_block_pool, check_fleet_ledger
+
+        msgs = []
+        for sid, san in self.sans.items():
+            live = sid in self.fleet.services
+            # An evicted service's engine is parked mid-flight — its pool
+            # legitimately holds abandoned residents; skip it.
+            if live:
+                msgs += [f"{sid}: {m}" for m in san.violations]
+                msgs += [f"{sid}: {m}" for m in check_block_pool(self.engines[sid])]
+        msgs += check_fleet_ledger(self.fleet)
+        return msgs
+
+    def drain(self) -> dict:
+        guard = 0
+        while (
+            self.submitted < len(self.requests)
+            or self.fleet._any_busy()
+            or self.fleet._promotion is not None
+            or self.fleet._meta
+        ):
+            guard += 1
+            if guard > 500:
+                raise RuntimeError("drain did not converge in 500 rounds")
+            while self.submitted < len(self.requests):
+                self._submit_next()
+            if self.fleet._promotion is not None:
+                self.fleet._advance_promotion()
+            for sid in sorted(self.fleet.services):
+                self._step(sid)
+        return dict(self.results)
+
+
+class FleetEvictScenario(_FleetScenario):
+    """Replica eviction with session replay, interleaved with live
+    traffic: at any point while both services stand, `s0` can be evicted
+    — its vnodes fall to `s1`, its in-flight sessions replay there from
+    their bound keys, and every result must stay bitwise identical to
+    the no-eviction reference (the PR 14 replay contract, checked across
+    every admission/dispatch interleaving instead of one)."""
+
+    name = "fleet_evict"
+    depth = 18
+    max_schedules = 800
+    n_requests = 8
+
+    def reset(self) -> None:
+        super().reset()
+        self.evicted = False
+
+    def enabled(self) -> list[Action]:
+        acts = []
+        if self.submitted < len(self.requests):
+            acts.append(
+                Action(f"submit{self.submitted}", {"router", self._route_of_next()})
+            )
+        for sid in sorted(self.fleet.services):
+            eng = self.engines[sid]
+            svc = self.fleet.services[sid]
+            if svc.lanes.pending or eng.scheduler.pending or eng.occupied or eng.inflight_chunks:
+                acts.append(Action(f"step_{sid}", {sid}))
+        if not self.evicted and len(self.fleet.services) > 1:
+            acts.append(Action("evict", {"router", "s0", "s1"}))
+        return acts
+
+    def apply(self, name: str) -> None:
+        if name.startswith("submit"):
+            self._submit_next()
+        elif name.startswith("step_"):
+            self._step(name[5:])
+        elif name == "evict":
+            self.fleet.evict_service("s0", reason="model-check eviction")
+            self.evicted = True
+        else:
+            raise KeyError(name)
+
+
+class FleetPromoteScenario(_FleetScenario):
+    """Verified promotion under traffic: arm a hot swap (to a checkpoint
+    byte-identical to the live one, so content is flip-invariant), then
+    interleave its state machine — shadow load, fleet-wide probe, per-
+    service drain + hold + flip + held release — with submissions and
+    service rounds. The zero-drop ledger must hold at EVERY state: a
+    request accepted into a swap window is held and released, never
+    dropped."""
+
+    name = "fleet_promote"
+    depth = 12
+    # Promotion schedules are the most expensive to replay (the drain runs
+    # the full shadow-load → probe → drain/hold/flip/release state machine
+    # per schedule), so this cap sits closer to the 500-schedule floor
+    # than the ~50 ms/schedule engine scenarios' 800.
+    max_schedules = 560
+    engine_kw = dict(hot_swap=True)
+
+    def reset(self) -> None:
+        super().reset()
+        self.armed = False
+
+    def enabled(self) -> list[Action]:
+        acts = []
+        if self.submitted < len(self.requests):
+            acts.append(
+                Action(
+                    f"submit{self.submitted}",
+                    {"router", "hold", self._route_of_next()},
+                )
+            )
+        for sid in sorted(self.fleet.services):
+            eng = self.engines[sid]
+            svc = self.fleet.services[sid]
+            if svc.lanes.pending or eng.scheduler.pending or eng.occupied or eng.inflight_chunks:
+                acts.append(Action(f"step_{sid}", {sid}))
+        if not self.armed:
+            acts.append(Action("promote_arm", {"promo"}))
+        elif self.fleet._promotion is not None:
+            acts.append(
+                Action("promote_advance", {"promo", "hold", "s0", "s1"})
+            )
+        return acts
+
+    def apply(self, name: str) -> None:
+        if name.startswith("submit"):
+            self._submit_next()
+        elif name.startswith("step_"):
+            self._step(name[5:])
+        elif name == "promote_arm":
+            # at_time=0.0 arms the state machine without running it
+            # synchronously — promote_advance drives each phase as an
+            # explored action.
+            _, _, params, _ = _ci_setup()
+            self.fleet.promote(params, at_time=0.0)
+            self.armed = True
+        elif name == "promote_advance":
+            self.fleet._advance_promotion()
+        else:
+            raise KeyError(name)
+
+
+# --------------------------------------------------------------------------
+# Registry + entry points
+# --------------------------------------------------------------------------
+
+SCENARIOS: dict[str, Callable[[], Scenario]] = {
+    cls.name: cls
+    for cls in (
+        EnginePipelineScenario,
+        EngineRecycleScenario,
+        ForkCowScenario,
+        ServiceDeadlineScenario,
+        FleetEvictScenario,
+        FleetPromoteScenario,
+    )
+}
+
+
+def run_scenario(
+    name: str,
+    max_schedules: Optional[int] = None,
+    depth: Optional[int] = None,
+) -> dict:
+    """Builds and explores one scenario; returns its report dict."""
+    scenario = SCENARIOS[name]()
+    if depth is not None:
+        scenario.depth = depth
+    scenario.build()
+    report = Explorer(scenario, max_schedules=max_schedules).run()
+    return report.to_dict()
+
+
+def run_all(
+    max_schedules: Optional[int] = None,
+    scenarios: Optional[Iterable[str]] = None,
+) -> tuple[list[str], dict]:
+    """Explores every scenario. Returns ``(problems, report)`` — problems
+    is the graftcheck gate's flat message list (empty = clean)."""
+    problems: list[str] = []
+    reports: dict[str, dict] = {}
+    for name in scenarios if scenarios is not None else sorted(SCENARIOS):
+        rep = run_scenario(name, max_schedules=max_schedules)
+        reports[name] = rep
+        for v in rep["violations"]:
+            problems.append(
+                f"model_check[{name}]: {v['messages'][0]} "
+                f"(minimal failing schedule: {v['minimal']})"
+            )
+    report = {
+        "scenarios": reports,
+        "total_schedules": sum(r["schedules"] for r in reports.values()),
+    }
+    return problems, report

@@ -15,6 +15,14 @@ from .spec import SpecConfig, truncated_draft  # noqa: F401
 from .fleet import FleetHealthConfig, FleetResult, PrefillStream, ServingFleet  # noqa: F401
 from .ingest import IngestedSubject, OnlineIngester, RejectedSubject  # noqa: F401
 from .router import ConsistentHashRouter, stable_hash  # noqa: F401
+from .sanitizer import (  # noqa: F401
+    BlockLedgerError,
+    ControlPlaneSanitizer,
+    SanitizerViolation,
+    attach_sanitizer,
+    check_block_pool,
+    check_fleet_ledger,
+)
 from .scheduler import (  # noqa: F401
     AdmissionRejected,
     AdmissionGroup,

@@ -159,6 +159,9 @@ class BlockAllocator:
         self.cover_events = 0
         self.allocs_total = 0
         self.frees_total = 0
+        # Optional ControlPlaneSanitizer (serving.sanitizer) recording
+        # alloc/free provenance; None outside debug/model-check runs.
+        self.sanitizer = None
 
     @property
     def free_blocks(self) -> int:
@@ -185,20 +188,42 @@ class BlockAllocator:
             self._rc[b] = 1
         self.allocs_total += n
         self.high_water = max(self.high_water, self.in_use)
+        if self.sanitizer is not None:
+            self.sanitizer.note_block_event("alloc", out)
         return out
 
     def incref(self, blocks, n: int = 1) -> None:
         for b in blocks:
             self._rc[b] += n
+        if self.sanitizer is not None:
+            self.sanitizer.note_block_event("incref", blocks)
 
     def decref(self, blocks) -> int:
+        # Always-on ledger guards (not gated on the sanitizer): a refcount
+        # underflow or a zero-block free corrupts the free list, which
+        # would hand the same physical block to two tenants on the next
+        # admission — fail here, at the event, with provenance.
+        from .sanitizer import BlockLedgerError
+
         freed = 0
         for b in blocks:
+            if b == 0:
+                raise BlockLedgerError(
+                    "decref of the reserved zero block (block 0 backs every "
+                    "unwritten table entry and must never be freed)"
+                )
+            if self._rc[b] <= 0:
+                raise BlockLedgerError(
+                    f"double-free of block {int(b)}: refcount is "
+                    f"{int(self._rc[b])} before this decref"
+                )
             self._rc[b] -= 1
             if self._rc[b] == 0:
                 self._free.append(b)
                 freed += 1
         self.frees_total += freed
+        if self.sanitizer is not None:
+            self.sanitizer.note_block_event("decref", blocks)
         return freed
 
     def note_cover(self, cover_events: int, allocated_blocks: int) -> None:
@@ -731,6 +756,11 @@ class GenerationEngine:
         self._swap_reshard_memo = None
         self._swap_draft_reshard_memo = None
         self.weights_version = 0
+
+        # Optional ControlPlaneSanitizer (serving.sanitizer): attach with
+        # `attach_sanitizer(engine)` for debug/model-check oracles; every
+        # hook is an `is not None` no-op when detached.
+        self.sanitizer = None
 
         # Tensor-parallel layouts pin the output state to the input layout:
         # without the pin GSPMD propagation reshards small replicated state
@@ -2793,6 +2823,8 @@ class GenerationEngine:
         for s, bad in emit:
             req = self._table[s]
             self._table[s] = None
+            if self.sanitizer is not None:
+                self.sanitizer.note_harvest(s, req, chunk_index)
             spec_proposed = spec_accepted = 0
             if self.spec is not None:
                 # Rows 4/5 of the spec boundary pack: this tenant's proposal
@@ -3055,12 +3087,16 @@ class GenerationEngine:
         except AttributeError:  # older jax Array impls: resolve() blocks
             pass
         self._inflight.append((self._dispatched_chunks, boundary))
+        if self.sanitizer is not None:
+            self.sanitizer.note_issue(self._dispatched_chunks)
 
     def resolve_chunk(self, now: float, fetch_results: bool = True) -> list[EngineResult]:
         """Resolves the OLDEST in-flight boundary and harvests its finished
         rows. Blocks only if that boundary's async copy has not landed yet
         (in steady state it has — the device raced ahead)."""
         chunk_index, boundary = self._inflight.popleft()
+        if self.sanitizer is not None:
+            self.sanitizer.note_resolve(chunk_index)
         host = np.asarray(boundary)  # graftcheck: allow GC001 -- chunk-boundary readback by design (async copy started at dispatch)
         self._resolved_chunks += 1
         return self._harvest(host, chunk_index, now, fetch_results)
@@ -3307,6 +3343,11 @@ class GenerationEngine:
             self._block_alloc.reset_occupancy()
             self._tables[:] = 0
             self.scheduler.block_pool_stats = self._block_pool_stats
+        if self.sanitizer is not None:
+            # Re-hook the fresh Scheduler (and keep allocator/engine wiring);
+            # the event log restarts with the control-plane state.
+            self.sanitizer.rebind(self)
+            self.sanitizer.reset_log()
 
     # ---------------------------------------------------------- accounting
     def _block_pool_stats(self) -> dict:

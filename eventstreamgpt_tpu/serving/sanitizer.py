@@ -1,0 +1,300 @@
+"""Runtime refcount/ledger sanitizer for the serving control plane.
+
+The paged block pool, the pipelined boundary queue, and the fleet's
+physical request ledger are all host-side state machines whose invariants
+the example-based suites only probe at a few points. This module is the
+*oracle* form of those invariants: a debug-mode event recorder
+(`ControlPlaneSanitizer`) that hooks the real objects' choke points —
+block alloc/incref/decref with provenance and epoch stamps, chunk
+issue/resolve order, admission-index binding, harvest-once — plus pure
+state checkers (`check_block_pool`, `check_fleet_ledger`) callable at any
+quiescent instant.
+
+Two consumers:
+
+* **graftcheck Tier D** (`analysis/model_check.py`) attaches a sanitizer
+  per engine and evaluates the checkers after every action of every
+  explored interleaving — a violation fails the schedule and is shrunk to
+  a minimal reproduction.
+* **The existing fault/e2e suites** attach one around a normal run and
+  assert `assert_clean()` at the end (tests/test_paged_cache.py,
+  tests/test_serving_faults.py) — the same oracles, amortized over the
+  example-based traffic they already generate.
+
+The sanitizer is pure recording + numpy checks: attaching one never
+changes dispatch behavior, key derivation, or results (the engine hooks
+are `if self.sanitizer is not None` no-ops when detached). The only
+always-on guards live in `BlockAllocator.decref` itself — double-free and
+zero-block-free raise `BlockLedgerError` even without a sanitizer, because
+by the time a later check could notice, the corrupted free list has
+already handed the same physical block to two tenants.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+__all__ = [
+    "BlockLedgerError",
+    "SanitizerViolation",
+    "ControlPlaneSanitizer",
+    "attach_sanitizer",
+    "check_block_pool",
+    "check_fleet_ledger",
+]
+
+
+class BlockLedgerError(RuntimeError):
+    """A physical block-pool ledger violation (double-free, zero-block
+    free, refcount underflow) — raised from `BlockAllocator` itself so the
+    corrupted free list can never serve another admission."""
+
+
+class SanitizerViolation(AssertionError):
+    """Raised by `ControlPlaneSanitizer` in fail-fast mode when a recorded
+    event breaks a control-plane invariant."""
+
+
+def _block_refs(engine) -> np.ndarray:
+    """Per-block reference counts implied by the engine's resident block
+    tables — the ground truth the allocator's ``_rc`` must match."""
+    tables = np.asarray(engine._tables)
+    held = tables[tables != 0].ravel()
+    return np.bincount(held, minlength=engine._block_alloc.num_blocks)
+
+
+def check_block_pool(engine) -> list[str]:
+    """Block-pool refcount conservation for one paged engine.
+
+    No leak (every rc the allocator holds is visible in some resident
+    table row), no dangling reference (no table row points at a block the
+    allocator thinks is free), the zero block is never allocated or freed,
+    the free list holds no duplicates, and free + in-use partitions the
+    usable pool exactly. Safe at any host-quiescent instant — deferred
+    freeing means a done row legitimately holds blocks until re-admission,
+    and that is still conservation (the row IS the reference).
+    """
+    if not getattr(engine, "paged_kv", False):
+        return []
+    a = engine._block_alloc
+    problems: list[str] = []
+    rc = np.asarray(a._rc)  # graftcheck: allow GC008 -- read-only conservation oracle
+    refs = _block_refs(engine)
+    if rc[0] != 0:
+        problems.append(f"zero block carries refcount {int(rc[0])} (must stay 0)")
+    if 0 in a._free:  # graftcheck: allow GC008 -- read-only conservation oracle
+        problems.append("zero block is on the free list (must never be freed)")
+    if (rc < 0).any():
+        bad = np.nonzero(rc < 0)[0].tolist()
+        problems.append(f"negative refcount (double-free) on blocks {bad}")
+    mismatch = np.nonzero(rc[1:] != refs[1:])[0] + 1
+    for b in mismatch.tolist():
+        kind = "leaked" if rc[b] > refs[b] else "dangling"
+        problems.append(
+            f"block {b} {kind}: allocator rc={int(rc[b])} but {int(refs[b])} "
+            "resident table reference(s)"
+        )
+    free = list(a._free)  # graftcheck: allow GC008 -- read-only conservation oracle
+    if len(free) != len(set(free)):
+        problems.append("free list holds duplicate blocks")
+    free_set = set(free)
+    rc_free = {int(b) for b in range(1, a.num_blocks) if rc[b] == 0}
+    if free_set != rc_free:
+        problems.append(
+            f"free list desynced from refcounts: {sorted(free_set ^ rc_free)}"
+        )
+    if len(free) + a.in_use != a.num_blocks - 1:
+        problems.append(
+            f"pool does not partition: {len(free)} free + {a.in_use} in use "
+            f"!= {a.num_blocks - 1} usable"
+        )
+    return problems
+
+
+def check_fleet_ledger(fleet) -> list[str]:
+    """The fleet's physical zero-drop ledger and session-affinity map.
+
+    Every accepted-minus-completed request must live somewhere physical
+    (a held queue or a service's pending set — `swap_report` computes
+    exactly this), every in-flight index's recorded service must still be
+    part of the fleet, and every index routed to a NON-held, NON-evicted
+    service must agree with the current ring (affinity stability: only
+    evictions remap sessions, and only the evicted service's).
+    """
+    problems: list[str] = []
+    report = fleet.swap_report()
+    if report["swap_dropped_requests"] != 0:
+        problems.append(
+            f"zero-drop ledger violated: accepted - completed - in_flight = "
+            f"{report['swap_dropped_requests']} (accepted={fleet._accepted_total}, "
+            f"completed={fleet._completed_total}, in_flight={report['in_flight']})"
+        )
+    for i, meta in fleet._meta.items():
+        sid = meta["service"]
+        if sid not in fleet.services:
+            problems.append(
+                f"fleet index {i} is routed to {sid!r}, which is not part of "
+                "the fleet (evicted without replay?)"
+            )
+            continue
+        expected = fleet.router.route(meta["subject"])
+        if expected != sid:
+            problems.append(
+                f"session affinity broken: fleet index {i} (subject "
+                f"{meta['subject']!r}) recorded on {sid!r} but the ring owns "
+                f"it to {expected!r}"
+            )
+    return problems
+
+
+class ControlPlaneSanitizer:
+    """Per-engine event recorder for the serving control plane.
+
+    Attach with `attach_sanitizer(engine)`; the engine, its scheduler, and
+    its block allocator then report through the ``note_*`` hooks below.
+    Violations accumulate on ``self.violations`` (and raise
+    `SanitizerViolation` when ``fail_fast``); `assert_clean()` is the e2e
+    epilogue.
+
+    Recorded provenance (debug mode — the reason this exists beyond the
+    pure checkers): every alloc/incref/decref stamped with the engine's
+    dispatched-chunk epoch, so a leaked or double-freed block's last owner
+    and WHEN it went wrong are in the log, not just THAT it did.
+    """
+
+    def __init__(self, fail_fast: bool = False):
+        self.fail_fast = fail_fast
+        self.engine: Any = None
+        self.violations: list[str] = []
+        # chunk-index streams: issue order vs resolve order (strict FIFO)
+        self.issued: list[int] = []
+        self.resolved: list[int] = []
+        # admission_index -> request_id: the one-time fold_in binding
+        self.bound: dict[int, Any] = {}
+        # admission_index -> completion count (harvest-once)
+        self.completed: dict[int, int] = {}
+        # block -> last ledger event; plus the full event log
+        self.provenance: dict[int, dict] = {}
+        self.events: list[dict] = []
+
+    # ------------------------------------------------------------- plumbing
+    def _flag(self, msg: str) -> None:
+        self.violations.append(msg)
+        if self.fail_fast:
+            raise SanitizerViolation(msg)
+
+    def _epoch(self) -> int:
+        return getattr(self.engine, "_dispatched_chunks", -1)
+
+    def rebind(self, engine) -> None:
+        """(Re)installs the hooks on ``engine`` and its current scheduler/
+        allocator — `GenerationEngine.reset()` calls this because reset
+        builds a fresh `Scheduler`."""
+        self.engine = engine
+        engine.sanitizer = self
+        engine.scheduler.sanitizer = self
+        if getattr(engine, "paged_kv", False):
+            engine._block_alloc.sanitizer = self
+
+    def reset_log(self) -> None:
+        """Clears the recorded streams (one model-check replay = one log);
+        keeps the hook wiring."""
+        self.violations.clear()
+        self.issued.clear()
+        self.resolved.clear()
+        self.bound.clear()
+        self.completed.clear()
+        self.provenance.clear()
+        self.events.clear()
+
+    # ------------------------------------------------------- ledger events
+    def note_block_event(self, op: str, blocks) -> None:
+        ev = {"op": op, "blocks": [int(b) for b in blocks], "epoch": self._epoch()}
+        self.events.append(ev)
+        for b in ev["blocks"]:
+            self.provenance[b] = ev
+
+    def note_bind(self, admission_index: int, request_id) -> None:
+        if admission_index in self.bound:
+            self._flag(
+                f"admission index {admission_index} bound twice (requests "
+                f"{self.bound[admission_index]!r} and {request_id!r}) — the "
+                "one-time fold_in binding is broken"
+            )
+            return
+        if self.bound and admission_index <= max(self.bound):
+            self._flag(
+                f"admission index {admission_index} bound out of order "
+                f"(already bound up to {max(self.bound)})"
+            )
+        self.bound[admission_index] = request_id
+
+    def note_issue(self, chunk_index: int) -> None:
+        if self.issued and chunk_index != self.issued[-1] + 1:
+            self._flag(
+                f"chunk {chunk_index} issued after {self.issued[-1]} "
+                "(dispatch counter not contiguous)"
+            )
+        self.issued.append(chunk_index)
+
+    def note_resolve(self, chunk_index: int) -> None:
+        pos = len(self.resolved)
+        if pos >= len(self.issued) or self.issued[pos] != chunk_index:
+            expected = self.issued[pos] if pos < len(self.issued) else None
+            self._flag(
+                f"chunk {chunk_index} resolved out of FIFO order (expected "
+                f"{expected}; boundaries must resolve in issue order)"
+            )
+        self.resolved.append(chunk_index)
+
+    def note_harvest(self, slot: int, request, chunk_index: int) -> None:
+        idx = request.admission_index
+        if idx < 0:
+            self._flag(
+                f"slot {slot} harvested a request with no bound admission "
+                f"index ({request.request_id!r})"
+            )
+        epoch = self.engine._slot_epoch[slot]
+        if epoch >= chunk_index:
+            self._flag(
+                f"stale-boundary guard breached: slot {slot} (admitted at "
+                f"epoch {epoch}) harvested by chunk {chunk_index}'s boundary"
+            )
+        self.completed[idx] = self.completed.get(idx, 0) + 1
+        if self.completed[idx] > 1:
+            self._flag(
+                f"admission index {idx} harvested {self.completed[idx]} times "
+                "(harvest-once broken — a stale boundary reaped a recycled "
+                "slot's new tenant?)"
+            )
+
+    # ------------------------------------------------------------- checks
+    def check(self) -> list[str]:
+        """Runs the stateful pool conservation check now; new violations
+        are recorded and returned."""
+        before = len(self.violations)
+        for p in check_block_pool(self.engine):
+            self._flag(p)
+        return self.violations[before:]
+
+    def ok(self) -> bool:
+        return not self.violations
+
+    def assert_clean(self) -> None:
+        if self.violations:
+            raise SanitizerViolation(
+                f"{len(self.violations)} control-plane violation(s):\n  "
+                + "\n  ".join(self.violations)
+            )
+
+
+def attach_sanitizer(
+    engine, fail_fast: bool = False
+) -> ControlPlaneSanitizer:
+    """Attaches a fresh `ControlPlaneSanitizer` to ``engine`` (and its
+    scheduler/block allocator) and returns it."""
+    san = ControlPlaneSanitizer(fail_fast=fail_fast)
+    san.rebind(engine)
+    return san

@@ -259,6 +259,9 @@ class Scheduler:
         # Paged engines install a callable here (`GenerationEngine` block
         # allocator stats); its dict merges into `padding_report`.
         self.block_pool_stats: Any = None
+        # Optional ControlPlaneSanitizer (serving.sanitizer) observing
+        # admission-index binding; None outside debug/model-check runs.
+        self.sanitizer = None
 
     def submit(self, request: Request) -> Request:
         if request.prompt_len > max(self.buckets):
@@ -274,6 +277,8 @@ class Scheduler:
             )
         request.admission_index = self._next_admission
         self._next_admission += 1
+        if self.sanitizer is not None:
+            self.sanitizer.note_bind(request.admission_index, request.request_id)
         if request.fork is not None and request.branch_index == 0:
             # The session's bound index: branch keys without an explicit
             # session key fold off ``fold_in(engine_key, this index)``.

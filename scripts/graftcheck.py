@@ -12,23 +12,33 @@ census (``analysis/program_census.py``): every registered ``aot_programs``
 provider's compiled programs — toy AND scaled shapes — audited for peak
 HBM vs ``MEMORY.json``, donation-aliasing completeness, implicit
 resharding, and kind-resolved collective inventories (the scaled fsdp8
-backward must show reduce-scatter).
+backward must show reduce-scatter). Tier D runs the serving control-plane
+model checker (``analysis/model_check.py``): every schedule of enabled
+control-plane actions (admit, issue, resolve, fork, deadline, evict,
+promote) over the REAL engine/service/fleet objects up to a depth bound,
+with sleep-set partial-order reduction, checking the block-ledger /
+FIFO-boundary / zero-drop / determinism oracles at every state. Schedule
+counts pin byte-reproducibly in ``MODELCHECK.json`` (the MEMORY.json
+discipline) and every scenario must clear 500 post-POR interleavings.
 
 Usage:
     python scripts/graftcheck.py                 # Tier A over the repo
-    python scripts/graftcheck.py --tier all      # what CI runs (A+B+C)
+    python scripts/graftcheck.py --tier all      # what CI runs (A+B+C+D)
     python scripts/graftcheck.py --tier c --report-json report.json
+    python scripts/graftcheck.py --tier d --modelcheck-report report.json
     python scripts/graftcheck.py --write-baseline  # re-key the lint baseline
     python scripts/graftcheck.py --write-memory    # regenerate MEMORY.json
+    python scripts/graftcheck.py --write-modelcheck  # regenerate MODELCHECK.json
     python scripts/graftcheck.py baseline --prune  # drop stale baseline entries
     python scripts/graftcheck.py baseline --prune --check  # exit 1 if stale
     python scripts/graftcheck.py --list-rules
     python scripts/graftcheck.py path/to/file.py # lint specific files
 
 Exit codes: 0 clean, 1 new lint findings (or stale baseline under
-``baseline --prune --check``), 2 program-invariant violations.
-See docs/analysis.md for the rule catalog, baseline workflow, and the
-Tier C census contract.
+``baseline --prune --check``), 2 program-invariant or model-check
+violations. See docs/analysis.md for the rule catalog, baseline workflow,
+the Tier C census contract, and the Tier D action alphabet + POR
+soundness argument.
 """
 
 from __future__ import annotations
@@ -43,6 +53,11 @@ if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
 
 BASELINE_FP = REPO_ROOT / "eventstreamgpt_tpu" / "analysis" / "baseline.json"
+MODELCHECK_FP = REPO_ROOT / "MODELCHECK.json"
+
+# The ISSUE-level floor: every scenario must clear this many post-POR
+# interleavings or the exploration isn't meaningfully exhaustive.
+MIN_SCHEDULES_PER_SCENARIO = 500
 
 
 def run_tier_a(paths: list[Path], write_baseline: bool, no_baseline: bool) -> int:
@@ -180,6 +195,86 @@ def run_tier_c(report_json: Path | None, regen_memory: Path | None) -> int:
     return 0
 
 
+def _modelcheck_payload(report: dict) -> dict:
+    return {
+        "note": (
+            "graftcheck Tier D schedule-count pins: per-scenario post-POR "
+            "interleaving counts from analysis/model_check.py. Deterministic "
+            "(sorted DFS) — a diff means the scenario set, depths, or the "
+            "explored control-plane behavior changed. Regenerate with "
+            "scripts/graftcheck.py --write-modelcheck."
+        ),
+        "scenarios": {
+            name: {
+                "depth": rep["depth"],
+                "schedules": rep["schedules"],
+                "truncated": rep["truncated"],
+                "actions": rep["actions"],
+            }
+            for name, rep in sorted(report["scenarios"].items())
+        },
+        "total_schedules": report["total_schedules"],
+    }
+
+
+def run_tier_d(
+    report_json: Path | None,
+    regen_modelcheck: Path | None,
+    max_schedules: int | None = None,
+) -> int:
+    _provision_mesh()
+
+    from eventstreamgpt_tpu.analysis.model_check import run_all
+
+    problems, report = run_all(max_schedules=max_schedules)
+    payload = _modelcheck_payload(report)
+    if max_schedules is None:
+        for name, rep in sorted(report["scenarios"].items()):
+            if rep["schedules"] < MIN_SCHEDULES_PER_SCENARIO and not rep["violations"]:
+                problems.append(
+                    f"scenario {name!r} explored only {rep['schedules']} "
+                    f"schedule(s) (floor: {MIN_SCHEDULES_PER_SCENARIO}) — "
+                    "widen the scenario or raise its depth"
+                )
+    if regen_modelcheck is not None:
+        regen_modelcheck.write_text(json.dumps(payload, indent=1) + "\n")
+        print(f"graftcheck[D]: wrote regenerated schedule pins to {regen_modelcheck}")
+    if report_json is not None:
+        report_json.write_text(json.dumps(report, indent=1, sort_keys=True) + "\n")
+        print(f"graftcheck[D]: wrote per-scenario schedule report to {report_json}")
+    for p in problems:
+        print(f"graftcheck[D]: {p}")
+    if problems:
+        print(f"graftcheck[D]: FAIL — {len(problems)} violation(s)")
+        return 2
+    counts = ", ".join(
+        f"{name}={rep['schedules']}" for name, rep in sorted(report["scenarios"].items())
+    )
+    print(
+        f"graftcheck[D]: OK ({report['total_schedules']} post-POR schedules, "
+        f"all oracles clean: {counts})"
+    )
+    return 0
+
+
+def run_write_modelcheck() -> int:
+    _provision_mesh()
+
+    from eventstreamgpt_tpu.analysis.model_check import run_all
+
+    problems, report = run_all()
+    MODELCHECK_FP.write_text(json.dumps(_modelcheck_payload(report), indent=1) + "\n")
+    for p in problems:
+        print(f"graftcheck[D]: {p}")
+    print(f"graftcheck[D]: wrote schedule pins to {MODELCHECK_FP}")
+    if problems:
+        # A pin refresh must not paper over an oracle violation: the file is
+        # written (so diffs are inspectable) but the run fails.
+        print(f"graftcheck[D]: FAIL — {len(problems)} violation(s)")
+        return 2
+    return 0
+
+
 def run_write_memory() -> int:
     _provision_mesh()
 
@@ -219,10 +314,11 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
         "--tier",
-        choices=("a", "b", "c", "all"),
+        choices=("a", "b", "c", "d", "all"),
         default="a",
         help="a: AST lint (default, fast); b: lowered-program gates; "
-        "c: whole-fleet census (memory/donation/resharding); all: a+b+c (CI)",
+        "c: whole-fleet census (memory/donation/resharding); d: serving "
+        "control-plane model checker; all: a+b+c+d (CI)",
     )
     ap.add_argument("paths", nargs="*", type=Path, help="lint these files only (Tier A)")
     ap.add_argument(
@@ -262,6 +358,31 @@ def main(argv: list[str] | None = None) -> int:
         help="Tier C: also write the regenerated MEMORY.json from the same census "
         "pass (CI diffs it against the committed file without a second census)",
     )
+    ap.add_argument(
+        "--write-modelcheck",
+        action="store_true",
+        help="regenerate MODELCHECK.json from a fresh Tier D exploration and exit",
+    )
+    ap.add_argument(
+        "--modelcheck-report",
+        type=Path,
+        default=None,
+        help="Tier D: write the per-scenario schedule-count report here (CI artifact)",
+    )
+    ap.add_argument(
+        "--regen-modelcheck",
+        type=Path,
+        default=None,
+        help="Tier D: also write the regenerated MODELCHECK.json from the same "
+        "exploration (CI diffs it against the committed file without a second run)",
+    )
+    ap.add_argument(
+        "--max-schedules",
+        type=int,
+        default=None,
+        help="Tier D: cap schedules per scenario (quick local runs; disables "
+        "the per-scenario floor check and the pin regen should not be committed)",
+    )
     ap.add_argument("--list-rules", action="store_true", help="print the rule catalog")
     args = ap.parse_args(argv)
 
@@ -281,6 +402,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.write_memory:
         return run_write_memory()
+    if args.write_modelcheck:
+        return run_write_modelcheck()
 
     rc = 0
     if args.tier in ("a", "all"):
@@ -291,6 +414,10 @@ def main(argv: list[str] | None = None) -> int:
         rc = run_tier_b(args.tolerance, args.skip_compile)
     if rc == 0 and args.tier in ("c", "all"):
         rc = run_tier_c(args.report_json, args.regen_memory)
+    if rc == 0 and args.tier in ("d", "all"):
+        rc = run_tier_d(
+            args.modelcheck_report, args.regen_modelcheck, args.max_schedules
+        )
     return rc
 
 

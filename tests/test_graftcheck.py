@@ -461,6 +461,217 @@ class TestGC005UndonatedTrainStep:
         assert "GC005" not in rule_ids(src)
 
 
+# ------------------------------------------- GC006-GC008 (serving-scoped)
+SERVING_PATH = "eventstreamgpt_tpu/serving/fixture.py"
+
+
+class TestGC006SetIteration:
+    def test_for_over_set_literal_fires(self):
+        src = """
+        def place(slots):
+            for s in {3, 1, 2}:
+                slots.admit(s)
+        """
+        assert ("GC006", 3) in rules_on(src, SERVING_PATH)
+
+    def test_for_over_set_call_fires(self):
+        src = """
+        def evict(sessions):
+            for sid in set(sessions):
+                sessions.drop(sid)
+        """
+        assert "GC006" in rule_ids(src, SERVING_PATH)
+
+    def test_comprehension_over_set_var_fires(self):
+        src = """
+        def order(pending):
+            ready = {r for r in pending if r.ok}
+            return [r.key for r in ready]
+        """
+        assert ("GC006", 4) in rules_on(src, SERVING_PATH)
+
+    def test_sorted_wrap_is_clean(self):
+        src = """
+        def place(slots):
+            ready = set(slots)
+            for s in sorted(ready):
+                admit(s)
+        """
+        assert "GC006" not in rule_ids(src, SERVING_PATH)
+
+    def test_membership_test_is_clean(self):
+        src = """
+        def gate(live, sid):
+            seen = {1, 2, 3}
+            if sid in seen:
+                return live
+        """
+        assert "GC006" not in rule_ids(src, SERVING_PATH)
+
+    def test_outside_serving_is_clean(self):
+        src = """
+        def anywhere():
+            for x in {1, 2}:
+                print(x)
+        """
+        assert "GC006" not in rule_ids(src, "eventstreamgpt_tpu/training/fixture.py")
+
+    def test_reassigned_to_list_is_clean(self):
+        src = """
+        def place(slots):
+            ready = set(slots)
+            ready = sorted(ready)
+            for s in ready:
+                admit(s)
+        """
+        assert "GC006" not in rule_ids(src, SERVING_PATH)
+
+
+class TestGC007NondeterministicSources:
+    def test_builtin_hash_fires(self):
+        src = """
+        def route(subject, n):
+            return hash(subject) % n
+        """
+        assert "GC007" in rule_ids(src, SERVING_PATH)
+
+    def test_wall_clock_fires(self):
+        src = """
+        import time
+
+        def arrival():
+            return time.time()
+        """
+        assert "GC007" in rule_ids(src, SERVING_PATH)
+
+    def test_random_module_fires(self):
+        src = """
+        import random
+
+        def pick(replicas):
+            return random.choice(replicas)
+        """
+        assert "GC007" in rule_ids(src, SERVING_PATH)
+
+    def test_uuid4_fires(self):
+        src = """
+        import uuid
+
+        def request_id():
+            return str(uuid.uuid4())
+        """
+        assert "GC007" in rule_ids(src, SERVING_PATH)
+
+    def test_perf_counter_is_sanctioned(self):
+        src = """
+        import time
+
+        def measure():
+            t0 = time.perf_counter()
+            return time.perf_counter() - t0
+        """
+        assert "GC007" not in rule_ids(src, SERVING_PATH)
+
+    def test_jax_random_is_clean(self):
+        src = """
+        import jax
+
+        def draw(key):
+            return jax.random.uniform(key)
+        """
+        assert "GC007" not in rule_ids(src, SERVING_PATH)
+
+    def test_outside_serving_is_clean(self):
+        src = """
+        def anywhere(x):
+            return hash(x)
+        """
+        assert "GC007" not in rule_ids(src, "eventstreamgpt_tpu/data/fixture.py")
+
+    def test_inline_waiver_suppresses(self):
+        src = """
+        import time
+
+        def stamp():
+            return time.time()  # graftcheck: allow GC007 -- log timestamp, never a decision input
+        """
+        assert "GC007" not in rule_ids(src, SERVING_PATH)
+
+
+class TestGC008LedgerDiscipline:
+    def test_decref_outside_owners_fires(self):
+        src = """
+        class Engine:
+            def harvest(self, slot):
+                self._block_alloc.decref(self._tables[slot])
+        """
+        assert "GC008" in rule_ids(src, SERVING_PATH)
+
+    def test_alias_alloc_outside_owners_fires(self):
+        src = """
+        class Engine:
+            def admit(self, n):
+                a = self._block_alloc
+                return a.alloc(n)
+        """
+        assert "GC008" in rule_ids(src, SERVING_PATH)
+
+    def test_internal_touch_outside_owners_fires(self):
+        src = """
+        def steal(engine):
+            a = engine._block_alloc
+            return a._free.pop()
+        """
+        assert "GC008" in rule_ids(src, SERVING_PATH)
+
+    def test_sanctioned_owner_funcs_are_clean(self):
+        src = """
+        class Engine:
+            def _free_slot_blocks(self, slot):
+                self._block_alloc.decref(self._tables[slot])
+
+            def _plan_admission_tables(self, group):
+                alloc = self._block_alloc
+                return alloc.alloc(2)
+
+            def reset(self):
+                self._block_alloc.reset_occupancy()
+        """
+        assert "GC008" not in rule_ids(src, SERVING_PATH)
+
+    def test_allocator_class_itself_is_clean(self):
+        src = """
+        class BlockAllocator:
+            def decref(self, blocks):
+                for b in blocks:
+                    self._rc[b] -= 1
+                    if self._rc[b] == 0:
+                        self._free.append(b)
+        """
+        assert "GC008" not in rule_ids(src, SERVING_PATH)
+
+    def test_readonly_counters_are_clean(self):
+        src = """
+        def stats(engine):
+            a = engine._block_alloc
+            return {"in_use": a.in_use, "free": a.free_blocks}
+        """
+        assert "GC008" not in rule_ids(src, SERVING_PATH)
+
+
+class TestServingPackageDeterminismClean:
+    def test_serving_package_has_no_unbaselined_gc006_gc008(self):
+        # Satellite guarantee: the real control plane is clean under the
+        # determinism lint at HEAD (inline waivers are part of clean).
+        findings = lint_paths(default_targets(REPO_ROOT), REPO_ROOT)
+        baseline = load_baseline(
+            REPO_ROOT / "eventstreamgpt_tpu" / "analysis" / "baseline.json"
+        )
+        new, _ = apply_baseline(findings, baseline)
+        det = [f for f in new if f.rule in ("GC006", "GC007", "GC008")]
+        assert det == [], "\n".join(f.render() for f in det)
+
+
 # -------------------------------------------------------------- baseline
 class TestBaselineWorkflow:
     SRC = textwrap.dedent(

@@ -1,0 +1,328 @@
+"""graftcheck Tier D: the serving control-plane model checker.
+
+Three layers:
+
+* **Explorer/POR units** (pure Python, no jax): sleep-set reduction
+  prunes commuting reorders but explores every dependent order; greedy
+  delta-debug shrink lands on the minimal failing schedule; the
+  determinism oracle catches order-sensitive outcomes.
+* **Scenario smoke** (reduced schedule cap): the real engine scenario
+  wires up and explores clean — the fast guard that keeps Tier D
+  importable and the oracles quiet on healthy code.
+* **Seeded mutations** (slow): six hand-broken control-plane behaviors —
+  double-free, leaked fork block, dropped held promote request, removed
+  slot-epoch guard, LIFO boundary resolution, reused admission index —
+  each of which the explorer MUST catch and shrink. These pin the
+  checker's detection power: a refactor that silently weakens an oracle
+  fails here, not in production.
+
+The full-depth schedule counts pin against MODELCHECK.json in CI via
+``graftcheck --tier d --regen-modelcheck`` + ``git diff``; the slow test
+here re-pins one scenario so the pytest suite alone also notices drift.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.analysis.model_check import (
+    SCENARIOS,
+    Action,
+    Explorer,
+    Scenario,
+    run_scenario,
+)
+
+pytestmark = [pytest.mark.graftcheck, pytest.mark.model_check]
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# --------------------------------------------------------------------------
+# Explorer / POR units (no jax)
+# --------------------------------------------------------------------------
+
+
+class ToyScenario(Scenario):
+    """One-shot actions with declared resources; drain applies the rest in
+    sorted order. ``outcome_of`` maps the final applied order to the drain
+    result; ``bug_when`` marks an applied-set that breaks an invariant."""
+
+    name = "toy"
+    depth = 8
+
+    def __init__(self, defs, outcome_of=None, bug_when=None):
+        self.defs = dict(defs)
+        # Outcomes follow the explorer's convention: ("ok", ...payload).
+        self.outcome_of = outcome_of or (
+            lambda applied: {"out": ("ok",) + tuple(sorted(applied))}
+        )
+        self.bug_when = bug_when
+
+    def build(self):
+        pass
+
+    def reset(self):
+        self.applied = []
+
+    def enabled(self):
+        return [
+            Action(n, r) for n, r in sorted(self.defs.items()) if n not in self.applied
+        ]
+
+    def apply(self, name):
+        if name in self.applied:
+            raise KeyError(name)
+        self.applied.append(name)
+
+    def invariants(self):
+        if self.bug_when is not None and self.bug_when(self.applied):
+            return [f"toy invariant broken after {self.applied}"]
+        return []
+
+    def drain(self):
+        for act in sorted(self.defs):
+            if act not in self.applied:
+                self.applied.append(act)
+        return self.outcome_of(self.applied)
+
+
+class TestExplorerCore:
+    def test_independent_actions_are_reduced(self):
+        # Three pairwise-independent one-shot actions: 3! = 6 orderings,
+        # but every reorder commutes — sleep sets must prune below 6.
+        s = ToyScenario({"a": {"x"}, "b": {"y"}, "c": {"z"}})
+        s.build()
+        rep = Explorer(s).run()
+        assert rep.violations == []
+        assert rep.schedules < 6
+
+    def test_dependent_orders_all_explored(self):
+        # Two actions sharing a resource do NOT commute: both orders run.
+        hit = set()
+        s = ToyScenario(
+            {"d1": {"x"}, "d2": {"x"}},
+            outcome_of=lambda applied: (hit.add(tuple(applied)), {"out": ("ok",)})[1],
+        )
+        s.build()
+        Explorer(s).run()
+        assert ("d1", "d2") in hit and ("d2", "d1") in hit
+
+    def test_counts_are_deterministic(self):
+        defs = {"a": {"x"}, "b": {"x", "y"}, "c": {"y"}, "d": {"z"}}
+        counts = set()
+        for _ in range(3):
+            s = ToyScenario(defs)
+            s.build()
+            counts.add(Explorer(s).run().schedules)
+        assert len(counts) == 1
+
+    def test_violation_shrinks_to_minimal(self):
+        # Bug fires only in the NON-canonical order (bad2 before bad1) —
+        # an interleaving bug, invisible to the reference drain. Pads are
+        # noise the shrinker must drop; so is bad1 (the drain supplies it).
+        def bug(applied):
+            return (
+                "bad1" in applied
+                and "bad2" in applied
+                and applied.index("bad2") < applied.index("bad1")
+            )
+
+        s = ToyScenario(
+            {"bad1": {"x"}, "bad2": {"x"}, "pad1": {"p"}, "pad2": {"q"}},
+            bug_when=bug,
+        )
+        s.build()
+        rep = Explorer(s).run()
+        assert len(rep.violations) == 1
+        assert rep.violations[0]["minimal"] == ["bad2"]
+
+    def test_determinism_oracle_catches_order_sensitivity(self):
+        # Outcome depends on which dependent action ran first — the drain
+        # of a d2-first schedule must diverge from the reference.
+        def outcome(applied):
+            first = next(a for a in applied if a in ("d1", "d2"))
+            return {"out": ("ok", first)}
+
+        s = ToyScenario({"d1": {"x"}, "d2": {"x"}}, outcome_of=outcome)
+        s.build()
+        rep = Explorer(s).run()
+        assert len(rep.violations) == 1
+        assert rep.violations[0]["minimal"] == ["d2"]
+        assert "diverged from the reference" in rep.violations[0]["messages"][0]
+
+    def test_max_schedules_truncates_deterministically(self):
+        s = ToyScenario({"d1": {"x"}, "d2": {"x"}, "d3": {"x"}})
+        s.build()
+        rep = Explorer(s, max_schedules=2).run()
+        assert rep.schedules == 2
+        assert rep.truncated
+
+
+# --------------------------------------------------------------------------
+# Real-scenario smoke (reduced cap — the fast wiring guard)
+# --------------------------------------------------------------------------
+
+
+class TestScenarioSmoke:
+    def test_engine_pipeline_explores_clean(self):
+        rep = run_scenario("engine_pipeline", max_schedules=25)
+        assert rep["violations"] == []
+        assert rep["schedules"] == 25 and rep["truncated"]
+        assert {"admit0", "plan", "issue", "resolve"} <= set(rep["actions"])
+
+    def test_registry_covers_all_layers(self):
+        assert set(SCENARIOS) == {
+            "engine_pipeline",
+            "engine_recycle",
+            "fork_cow",
+            "service_deadline",
+            "fleet_evict",
+            "fleet_promote",
+        }
+
+
+# --------------------------------------------------------------------------
+# Seeded mutations — the explorer must catch every one
+# --------------------------------------------------------------------------
+
+
+def _first_violation(name, max_schedules=80):
+    rep = run_scenario(name, max_schedules=max_schedules)
+    assert rep["violations"], (
+        f"seeded mutation in scenario {name!r} was NOT caught in "
+        f"{rep['schedules']} schedule(s)"
+    )
+    v = rep["violations"][0]
+    assert "minimal" in v and "messages" in v
+    return v
+
+
+@pytest.mark.slow
+class TestSeededMutations:
+    def test_double_free_is_caught(self, monkeypatch):
+        from eventstreamgpt_tpu.serving.engine import GenerationEngine
+
+        orig = GenerationEngine._free_slot_blocks
+
+        def double_free(self, slot):
+            row = self._tables[slot]
+            held = [int(b) for b in row if b != 0]
+            if held:
+                self._block_alloc.decref(held)
+                self._block_alloc.decref(held)  # the seeded bug
+            row[:] = 0
+
+        monkeypatch.setattr(GenerationEngine, "_free_slot_blocks", double_free)
+        v = _first_violation("engine_recycle")
+        assert "double-free" in " ".join(v["messages"])
+
+    def test_leaked_fork_block_is_caught(self, monkeypatch):
+        from eventstreamgpt_tpu.serving.engine import GenerationEngine
+
+        orig = GenerationEngine._plan_admission_tables
+
+        def leaky(self, group):
+            read, scat = orig(self, group)
+            if group.fork is not None:
+                shared = [int(b) for b in np.asarray(read)[0] if b != 0][:1]
+                if shared:
+                    self._block_alloc.incref(shared)  # unpaired ref: a leak
+            return read, scat
+
+        monkeypatch.setattr(GenerationEngine, "_plan_admission_tables", leaky)
+        v = _first_violation("fork_cow")
+        assert "leaked" in " ".join(v["messages"])
+
+    def test_dropped_held_promote_request_is_caught(self, monkeypatch):
+        from eventstreamgpt_tpu.serving.fleet import ServingFleet
+
+        orig = ServingFleet._release_held
+
+        def dropper(self, sid):
+            held = self._held[sid]
+            if held:
+                held.popleft()  # silently drop one held request
+            orig(self, sid)
+
+        monkeypatch.setattr(ServingFleet, "_release_held", dropper)
+        v = _first_violation("fleet_promote", max_schedules=200)
+        joined = " ".join(v["messages"])
+        assert "drop" in joined or "drain did not converge" in joined
+
+    def test_removed_epoch_guard_is_caught(self, monkeypatch):
+        from eventstreamgpt_tpu.serving.engine import GenerationEngine
+
+        orig = GenerationEngine._dispatch_group
+
+        def unstamped(self, group):
+            orig(self, group)
+            for s in group.slots:
+                # erase the admission epoch: stale pipelined boundaries now
+                # pass the `_slot_epoch[s] < chunk_index` harvest guard
+                self._slot_epoch[s] = -(10**9)
+
+        monkeypatch.setattr(GenerationEngine, "_dispatch_group", unstamped)
+        v = _first_violation("engine_recycle", max_schedules=200)
+        assert v["messages"]
+
+    def test_lifo_boundary_resolution_is_caught(self, monkeypatch):
+        from eventstreamgpt_tpu.serving.engine import GenerationEngine
+
+        orig = GenerationEngine.resolve_chunk
+
+        def lifo(self, *args, **kwargs):
+            if len(self._inflight) > 1:
+                self._inflight.reverse()  # newest-first: LIFO resolution
+            return orig(self, *args, **kwargs)
+
+        monkeypatch.setattr(GenerationEngine, "resolve_chunk", lifo)
+        v = _first_violation("engine_pipeline", max_schedules=200)
+        assert "FIFO" in " ".join(v["messages"])
+
+    def test_reused_admission_index_is_caught(self, monkeypatch):
+        from eventstreamgpt_tpu.serving.scheduler import Scheduler
+
+        orig = Scheduler.submit
+
+        def reuser(self, request):
+            out = orig(self, request)
+            self._mut_count = getattr(self, "_mut_count", 0) + 1
+            if self._mut_count % 2 == 1:
+                self._next_admission -= 1  # the next admission reuses this index
+            return out
+
+        monkeypatch.setattr(Scheduler, "submit", reuser)
+        v = _first_violation("engine_pipeline")
+        # Caught either by the sanitizer's one-time-binding oracle ("bound
+        # twice") or downstream by the completed-twice harvest oracle —
+        # two requests sharing one admission index harvest the same key.
+        joined = " ".join(v["messages"])
+        assert "bound twice" in joined or "completed twice" in joined
+
+
+# --------------------------------------------------------------------------
+# Schedule-count pins (slow — CI's Tier D job diffs the full file)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestScheduleCountPins:
+    def test_engine_pipeline_count_matches_modelcheck_json(self):
+        pins = json.loads((REPO_ROOT / "MODELCHECK.json").read_text())
+        pinned = pins["scenarios"]["engine_pipeline"]
+        rep = run_scenario("engine_pipeline")
+        assert rep["violations"] == []
+        assert rep["schedules"] == pinned["schedules"]
+        assert rep["schedules"] >= 500  # the Tier D exhaustiveness floor
+        assert sorted(rep["actions"]) == pinned["actions"]
+
+    def test_all_pinned_scenarios_clear_the_floor(self):
+        pins = json.loads((REPO_ROOT / "MODELCHECK.json").read_text())
+        assert set(pins["scenarios"]) == set(SCENARIOS)
+        for name, rec in pins["scenarios"].items():
+            assert rec["schedules"] >= 500, (
+                f"{name} pinned below the 500-schedule exhaustiveness floor"
+            )

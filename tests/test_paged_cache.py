@@ -36,7 +36,13 @@ import numpy as np
 import pytest
 
 from eventstreamgpt_tpu.models.ci_model import CIPPTForGenerativeSequenceModeling
-from eventstreamgpt_tpu.serving import GenerationEngine, Request
+from eventstreamgpt_tpu.serving import (
+    BlockLedgerError,
+    GenerationEngine,
+    Request,
+    attach_sanitizer,
+    check_block_pool,
+)
 from eventstreamgpt_tpu.serving.engine import derive_request_key
 from eventstreamgpt_tpu.serving.fleet import ServingFleet
 from eventstreamgpt_tpu.serving.service import ServingService
@@ -71,7 +77,14 @@ def engine_for(ci, *, paged=True, **kw):
     if paged:
         kw.setdefault("paged_kv", True)
         kw.setdefault("block_size", BLOCK)
-    return GenerationEngine(model, params, config, template=prompt, **kw)
+    engine = GenerationEngine(model, params, config, template=prompt, **kw)
+    if paged:
+        # Every paged engine in this suite runs under the control-plane
+        # sanitizer (serving/sanitizer.py): block alloc/free provenance,
+        # FIFO boundary order, harvest-once — fail-fast, so a ledger bug
+        # surfaces at the violating event, not as downstream corruption.
+        attach_sanitizer(engine, fail_fast=True)
+    return engine
 
 
 def mixed_requests(prompt, n=4, start_id=0):
@@ -481,3 +494,47 @@ class TestEvaluatorFork:
         np.testing.assert_array_equal(out_e.preds, out_r.preds)
         np.testing.assert_array_equal(out_e.labels, out_r.labels)
         np.testing.assert_array_equal(frac_e, frac_r)
+
+
+# ------------------------------------------------- control-plane sanitizer
+class TestSanitizerWiring:
+    """The runtime refcount/ledger sanitizer over this suite's traffic.
+
+    `engine_for` attaches one (fail-fast) to every paged engine above, so
+    every parity/fork/fleet test doubles as sanitizer coverage; these
+    tests pin the epilogue contract and the always-on allocator guards."""
+
+    def test_e2e_traffic_leaves_ledger_clean(self, ci):
+        _, _, _, prompt = ci
+        eng = engine_for(ci)
+        eng.run(mixed_requests(prompt))
+        san = eng.sanitizer
+        san.assert_clean()
+        assert check_block_pool(eng) == []
+        # harvest-once held: every bound admission completed exactly once
+        assert set(san.completed) == set(san.bound)
+        assert all(n == 1 for n in san.completed.values())
+        # strict FIFO held: boundaries resolved in issue order
+        assert san.resolved == san.issued[: len(san.resolved)]
+
+    def test_fork_traffic_leaves_ledger_clean(self, ci):
+        _, _, _, prompt = ci
+        eng = engine_for(ci, n_slots=3)
+        sub = prompt.slice((slice(0, 1), slice(0, 4)))
+        eng.fork(sub, n_branches=3, max_new_events=4, request_id="b")
+        eng.run([])
+        eng.sanitizer.assert_clean()
+        assert check_block_pool(eng) == []
+
+    def test_double_free_raises_even_without_sanitizer(self, ci):
+        eng = engine_for(ci)
+        alloc = eng._block_alloc
+        blocks = alloc.alloc(1)
+        alloc.decref(blocks)
+        with pytest.raises(BlockLedgerError, match="double-free"):
+            alloc.decref(blocks)
+
+    def test_zero_block_free_raises(self, ci):
+        eng = engine_for(ci)
+        with pytest.raises(BlockLedgerError, match="zero block"):
+            eng._block_alloc.decref([0])
