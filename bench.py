@@ -864,6 +864,98 @@ def main():
     kvq_slots = kvq_engine.slots_report()
     kvq_slots_ratio = kvq_slots["slots_per_chip_ratio_vs_bf16"]
 
+    # Quantized-cache NA decode A/B (r20; ROADMAP item 3 named this arm
+    # never-run): the NA engine — per-event dep-graph level walks — over
+    # the SAME offline request set, int8 KV planes vs the float cache.
+    # The measured throughput ratio runs at the bench width; the
+    # ladder-width half of the verdict is allocation-free
+    # (kv_cache_bytes_per_slot at each r10 rung — the capacity ratio is
+    # analytic, so production widths need no wide NA compile here). The
+    # parity side is tier-1-gated (tests/test_kv_quant.py NA int8 vs
+    # float generate()); this key is the measured bandwidth verdict.
+    from eventstreamgpt_tpu.ops.kv_quant import kv_cache_bytes_per_slot
+
+    tunnel_probe("kvq_na_ab", extras)
+
+    def na_engine_variant(**kw):
+        return GenerationEngine(
+            na_model,
+            na_state.params,
+            na_config,
+            template=eng_cohorts[0],
+            n_slots=BATCH,
+            max_len=SEQ_LEN,
+            decode_chunk=ENGINE_CHUNK,
+            dispatch_depth=1,
+            max_prompt_len=SEQ_LEN - GEN_NEW,
+            min_bucket=32,
+            base_key=jax.random.PRNGKey(11),
+            mesh=mesh,
+            **kw,
+        )
+
+    kvq_na_float_wall, kvq_na_float_useful = timed_engine_arm(na_engine_variant())
+    kvq_na_int8_wall, kvq_na_int8_useful = timed_engine_arm(
+        na_engine_variant(kv_cache_dtype="int8")
+    )
+    kvq_na_rate = kvq_na_int8_useful / kvq_na_int8_wall / n_devices
+    kvq_na_vs_float_ratio = round(
+        (kvq_na_int8_useful / kvq_na_int8_wall)
+        / max(kvq_na_float_useful / kvq_na_float_wall, 1e-9),
+        3,
+    )
+    kvq_na_ladder_bytes_per_slot = {
+        str(w): {
+            name: kv_cache_bytes_per_slot(
+                WIDE_LAYERS, WIDE_HEADS, SEQ_LEN, w // WIDE_HEADS, name
+            )
+            for name in ("bf16", "int8")
+        }
+        for w in WIDTH_LADDER
+    }
+
+    # r20 decode-megakernel A/B (the r06 discipline: identical offline
+    # work through each arm, the measured winner names the production
+    # default `decode_step_impl='auto'` resolves to): the per-op
+    # fused-XLA decode step vs the persistent Pallas layer-stack kernel
+    # (ops/pallas_decode_step.py) in interpreter mode. The kernel is
+    # single-replica for now (megakernel x mesh is an open matrix cell),
+    # so both arms drop the mesh — the delta is pure inner-step
+    # schedule. The interpreter carries Python-loop overhead on CPU
+    # hosts; the TPU run of the SAME arms (impl 'pallas', Mosaic-
+    # compiled) lands under the same tail keys, and parity either way is
+    # tier-1-gated in tests/test_decode_megakernel.py.
+    tunnel_probe("decode_megakernel_ab", extras)
+
+    def mega_engine_variant(**kw):
+        return GenerationEngine(
+            model,
+            state.params,
+            config,
+            template=eng_cohorts[0],
+            n_slots=BATCH,
+            max_len=SEQ_LEN,
+            decode_chunk=ENGINE_CHUNK,
+            dispatch_depth=1,
+            max_prompt_len=SEQ_LEN - GEN_NEW,
+            min_bucket=32,
+            base_key=jax.random.PRNGKey(11),
+            **kw,
+        )
+
+    decode_megakernel_ab_ms = {}
+    for arm, impl in (
+        ("xla_fused", "xla"),
+        ("pallas_interpret", "pallas_interpret"),
+    ):
+        mega_wall_s, _ = timed_engine_arm(
+            mega_engine_variant(decode_step_impl=impl)
+        )
+        decode_megakernel_ab_ms[arm] = round(1000.0 * mega_wall_s, 1)
+    decode_step_impl_winner = min(
+        decode_megakernel_ab_ms, key=decode_megakernel_ab_ms.get
+    )
+
     # ---- speculative decoding (r13; serving/spec.py): the truncated-depth
     # draft — the target's own first half, zero extra training — proposes
     # K events per slot per round and the target verifies all of them in
@@ -1651,7 +1743,7 @@ def main():
 
     # Key order is deliberate: the driver captures only the FINAL 2000
     # characters of stdout, so the detail/diagnostic fields print first and
-    # the headline fields (value / epoch_rates / tuning_loss) print LAST to
+    # the headline fields (value / tuning_loss) print LAST to
     # guarantee they land inside the tail window (VERDICT r05 weak #1).
     # Every *epoch_rates list is per-chip (÷ n_devices), matching the
     # adjacent *_events_per_sec_per_chip headline units.
@@ -1756,6 +1848,13 @@ def main():
                 ],
                 "kvq_useful_events": kvq_useful,
                 "kvq_offline_wall_s": round(kvq_wall_s, 3),
+                # r20 quantized-NA-decode detail (headline ratio in the
+                # tail): the int8 NA engine's absolute rate and the
+                # analytic per-rung capacity table behind
+                # kvq_na_vs_float_ratio — bytes/slot at each r10 ladder
+                # width, bf16 vs int8, allocation-free.
+                "kvq_na_engine_events_per_sec_per_chip": round(kvq_na_rate, 1),
+                "kvq_na_ladder_bytes_per_slot": kvq_na_ladder_bytes_per_slot,
                 # Online serving service detail (r08): geometry and per-lane
                 # latency behind the headline service_* keys in the tail.
                 "service_replicas": 1,
@@ -1869,12 +1968,22 @@ def main():
                 "modelcheck_schedules_explored": json.loads(
                     (Path(__file__).resolve().parent / "MODELCHECK.json").read_text()
                 )["total_schedules"],
+                # Detail keys displaced from the tail by the r20
+                # composition/megakernel verdicts (the 1900-char budget in
+                # tests/test_benchmarking.py): each one's headline
+                # equivalent — the remat A/B pair, the engine/service p95s,
+                # the per-chip pretrain value — remains in the tail block.
+                "width1024_probe_mfu_vs_197tflops": round(wide_mfu, 4),
+                "engine_p95_latency_ms": round(engine_p95, 1),
+                "service_vs_engine_p95_ratio": round(
+                    service_p95 / max(engine_p95, 1e-9), 3
+                ),
+                "epoch_rates": [round(r / n_devices, 1) for r, _, _ in epoch_rates],
                 # ---- headline block (must stay last: the driver captures
                 # only the final 2000 chars of stdout; per-chip units).
                 # Production-width remat-policy A/B (r06 lever 1): both arms
                 # every run; the measured winner carries the headline MFU.
                 "width1024_remat_ab_ms": {k: round(v, 2) for k, v in width_ab_ms.items()},
-                "width1024_probe_mfu_vs_197tflops": round(wide_mfu, 4),
                 # Width ladder + scan-over-layers headline (r10): per-rung
                 # step ms / MFU (null = rung skipped, reason in
                 # width_ladder_detail), the COLLECTIVES.json-derived
@@ -1908,7 +2017,6 @@ def main():
                 # prompt_i) through the engine vs the PR4 padded-cohort
                 # generate() path.
                 "engine_events_per_sec_per_chip": round(engine_rate, 1),
-                "engine_p95_latency_ms": round(engine_p95, 1),
                 # r09 lever 2: fused sampling tail (filter+gumbel+argmax+
                 # active-merge in one scope, Pallas on chip) vs the r07
                 # multi-op tail — identical requests, bit-identical outputs,
@@ -1921,6 +2029,19 @@ def main():
                 # that caps production batch size.
                 "kvq_engine_events_per_sec_per_chip": round(kvq_rate, 1),
                 "kvq_slots_per_chip_ratio": kvq_slots_ratio,
+                # r20: the quantized-cache NA decode A/B (ROADMAP item 3's
+                # never-run arm) — int8 NA engine throughput over the float
+                # NA engine on identical offline requests (> 1 = the
+                # bandwidth win survives the dep-graph walk; the per-rung
+                # capacity table is in kvq_na_ladder_bytes_per_slot above).
+                "kvq_na_vs_float_ratio": kvq_na_vs_float_ratio,
+                # r20 decode-megakernel A/B: fused-XLA inner step vs the
+                # persistent Pallas layer-stack kernel on identical offline
+                # work; the winner names what `decode_step_impl='auto'`
+                # resolves to (parity tier-1-gated in
+                # tests/test_decode_megakernel.py).
+                "decode_megakernel_ab_ms": decode_megakernel_ab_ms,
+                "decode_step_impl_winner": decode_step_impl_winner,
                 # Speculative decoding headline (r13): K-event draft +
                 # one-pass verify vs one-event-per-forward decode on the
                 # SAME offline requests (ratio > 1 = the draft pays for
@@ -1941,9 +2062,6 @@ def main():
                 # tail latency vs the synchronous engine arm; per-request
                 # outputs are bit-identical across both arms (tier-1 pin).
                 "service_p95_latency_ms": round(service_p95, 1),
-                "service_vs_engine_p95_ratio": round(
-                    service_p95 / max(engine_p95, 1e-9), 3
-                ),
                 # Pod-scale serving fleet headline (r12): the SAME Poisson
                 # trace through a 2-service consistent-hash router with a
                 # fleet-wide hot checkpoint swap armed at the trace
@@ -1992,7 +2110,6 @@ def main():
                 "paged_effective_slots_ratio": paged_effective_slots_ratio,
                 "fork_branches_per_prefill": fork_branches_per_prefill,
                 "tuning_loss": round(eval_metrics.get("tuning_loss", float("nan")), 4),
-                "epoch_rates": [round(r / n_devices, 1) for r, _, _ in epoch_rates],
                 "metric": "pretrain_events_per_sec_per_chip",
                 "unit": "events/sec/chip",
                 "vs_baseline": round(events_per_sec_per_chip / 5000.0, 3),

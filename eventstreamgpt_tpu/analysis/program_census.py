@@ -331,10 +331,16 @@ def aot_surface() -> dict[str, set[str]]:
         | {f"engine_sampling:{k}" for k in pc.canonical_sampling_engine_program()}
         | {f"engine_spec:{k}" for k in pc.canonical_spec_engine_programs(8)}
         | {f"engine_spec_na:{k}" for k in pc.canonical_spec_engine_na_programs()}
-        | {f"engine_paged:{k}" for k in pc.canonical_paged_engine_programs(8)},
+        | {f"engine_paged:{k}" for k in pc.canonical_paged_engine_programs(8)}
+        | {
+            f"engine_sampling_shard:{k}"
+            for k in pc.canonical_sharded_sampling_engine_programs(8)
+        }
+        | {f"engine_megakernel:{k}" for k in pc.canonical_megakernel_engine_program()},
         "service": {f"service:{k}" for k in pc.canonical_service_programs(8)},
         "fleet": {f"engine_tp:{k}" for k in pc.canonical_tp_engine_programs(4, 2)}
-        | {f"engine_swap:{k}" for k in pc.canonical_swap_engine_programs()},
+        | {f"engine_swap:{k}" for k in pc.canonical_swap_engine_programs()}
+        | {f"engine_composed:{k}" for k in pc.canonical_composed_engine_programs(4, 2)},
         "ladder": {
             "ladder:fsdp8@w2048",
             "ladder:fsdp8@w4096",

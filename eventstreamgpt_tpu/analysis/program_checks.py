@@ -414,6 +414,119 @@ def canonical_sampling_engine_program() -> dict:
     return engine.aot_programs(bucket_len=8, group=2)
 
 
+def canonical_sharded_sampling_engine_programs(n_data: int = 8) -> dict:
+    """The r20 sharded fused-sampling engine: the Pallas sampling kernel on
+    a MULTI-DEVICE data mesh, run under `shard_map` over the slot axis —
+    each device sweeps its own ``(n_slots/dp, V)`` logits shard, so the
+    grid never crosses the mesh axis. This retires the r09 mesh rule
+    (auto → fused-XLA tail on any mesh): the committed
+    ``engine_sampling_shard_dp8`` budget pins that the decode program
+    carries NO slot-plane logits gather — its collective inventory must
+    stay within the baseline ``engine_dp8`` kind set."""
+    import jax
+
+    from ..serving import GenerationEngine
+    from ..training.sharding import make_mesh
+
+    ge = _graft_entry()
+    _require_devices(n_data)
+    mesh = make_mesh(n_data, 1)
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=2 * n_data,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        mesh=mesh,
+        kv_cache_dtype="int8",
+        sampling_impl="pallas_interpret",
+    )
+    assert engine._shard_sampling, "dp8 + kernel tail must take the shard_map path"
+    return engine.aot_programs(bucket_len=8, group=2)
+
+
+def canonical_composed_engine_programs(n_data: int = 4, n_model: int = 2) -> dict:
+    """THE composed production configuration (r20 tentpole): speculative
+    decoding × int8 KV cache × serve-time tensor parallelism behind one
+    engine, with the dedicated-prefill split halves included. Every
+    capacity multiplier at once: spec's ~K× events per target forward,
+    int8's ~2× slots per chip, TP's width-past-one-chip — the
+    configuration the composition matrix exists to license. The committed
+    ``engine_composed_*_dp4_tp2`` budgets pin the contract that
+    composition pays exactly the per-layer TP reduce pattern the plain TP
+    engine already pays (zero NEW collective kinds vs ``engine_dp8``
+    beyond the documented TP reduces), and the donation audit keeps the
+    spec state's donation from being dropped by a layout reshard (the
+    out_shardings pin, Tier C fix)."""
+    import jax
+
+    from ..serving import GenerationEngine, SpecConfig, truncated_draft
+    from ..training.sharding import make_mesh
+
+    ge = _graft_entry()
+    _require_devices(n_data * n_model)
+    mesh = make_mesh(n_data, n_model)
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    dcfg, dparams = truncated_draft(model.config, params, 1)
+    draft_model = type(model)(dcfg)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=2 * n_data,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        mesh=mesh,
+        kv_cache_dtype="int8",
+        spec=SpecConfig(model=draft_model, params=dparams, config=dcfg, k=2),
+    )
+    assert engine.tensor_parallel and engine._kv_quantized
+    return engine.aot_programs(bucket_len=8, group=2, include_prefill_stream=True)
+
+
+def canonical_megakernel_engine_program() -> dict:
+    """The r20 fused decode megakernel engine, unsharded (one device — the
+    single-replica topology the persistent kernel targets):
+    ``decode_step_impl="pallas_interpret"`` routes the CI decode inner step
+    through ``ops/pallas_decode_step.py`` — the whole layer stack (LN →
+    qkv → cursor write → attention → MLP → event-mask zeroing) as ONE
+    Pallas grid, in interpreter mode on CPU (same program structure as the
+    TPU Mosaic compile modulo the kernel body). The decode program is
+    gated f64-free and host-transfer-free — the kernel must not smuggle
+    callbacks into the serving hot loop — and against a zero-collective
+    budget (``engine_megakernel_1dev``: single device ⇒ any collective is
+    a bug). Returns the full ``aot_programs`` dict so the Tier C census
+    covers every program this topology compiles."""
+    import jax
+
+    from ..serving import GenerationEngine
+
+    ge = _graft_entry()
+    model, batch = ge._make_model_and_batch(batch_size=2, seq_len=8)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine = GenerationEngine(
+        model,
+        params,
+        model.config,
+        template=batch,
+        n_slots=4,
+        max_len=12,
+        decode_chunk=2,
+        min_bucket=8,
+        decode_step_impl="pallas_interpret",
+    )
+    assert engine._decode_step_resolved == "pallas_interpret"
+    return engine.aot_programs(bucket_len=8, group=2)
+
+
 def canonical_tp_engine_programs(n_data: int = 4, n_model: int = 2) -> dict:
     """The serve-time tensor-parallel engine programs on a
     ``data×model`` mesh (``serving/engine.py`` with a ``model`` axis): the
@@ -784,6 +897,22 @@ def run_program_checks(
         programs[f"engine_tp:{label}"] = (fn, args)
     for label, (fn, args) in canonical_swap_engine_programs().items():
         programs[f"engine_swap:{label}"] = (fn, args)
+    # The r20 composition-closure programs: the slot-sharded fused-sampling
+    # engine on dp8 (the Pallas sampling grid runs on each slot shard — its
+    # decode budget pins "no slot-plane gather", retiring the r09 mesh
+    # fallback rule) and the composed spec × int8-cache × serve-time-TP
+    # engine on dp4×tp2 with the prefill-stream split — ONE engine carrying
+    # all three capacity multipliers; each program's budget pins "the
+    # per-layer TP reduce pattern and nothing more" over the spec budgets.
+    for label, (fn, args) in canonical_sharded_sampling_engine_programs(8).items():
+        programs[f"engine_sampling_shard:{label}"] = (fn, args)
+    for label, (fn, args) in canonical_composed_engine_programs(4, 2).items():
+        programs[f"engine_composed:{label}"] = (fn, args)
+    # The r20 fused decode megakernel (single-replica topology, interpreter
+    # mode): the persistent Pallas layer-stack kernel must stay callback-
+    # free inside the decode hot loop and zero-collective by construction.
+    for label, (fn, args) in canonical_megakernel_engine_program().items():
+        programs[f"engine_megakernel:{label}"] = (fn, args)
 
     lowered = {}
     for label, (fn, args) in programs.items():
@@ -834,6 +963,15 @@ def run_program_checks(
         budget_keys["engine_tp:prefill_compute_b8"] = "engine_tp_prefill_compute_dp4_tp2"
         budget_keys["engine_tp:admit"] = "engine_tp_admit_dp4_tp2"
         budget_keys["engine_swap:swap_reshard"] = "engine_swap_reshard_1dev"
+        budget_keys["engine_sampling_shard:decode"] = "engine_sampling_shard_dp8"
+        budget_keys["engine_composed:draft_chunk"] = "engine_composed_draft_dp4_tp2"
+        budget_keys["engine_composed:verify"] = "engine_composed_verify_dp4_tp2"
+        budget_keys["engine_composed:prefill_b8"] = "engine_composed_prefill_dp4_tp2"
+        budget_keys["engine_composed:prefill_compute_b8"] = (
+            "engine_composed_prefill_compute_dp4_tp2"
+        )
+        budget_keys["engine_composed:admit"] = "engine_composed_admit_dp4_tp2"
+        budget_keys["engine_megakernel:decode"] = "engine_megakernel_1dev"
         for label, budget_key in budget_keys.items():
             log(f"compiling {label} for the collective budget gate")
             compiled = lowered[label].compile()

@@ -32,18 +32,26 @@ tail while keeping its bit-exact ``generate()`` parity contract
 ``$ESGPT_PALLAS_IMPL``); ``"pallas_interpret"`` runs the kernel on any
 backend for CPU CI.
 
-Multi-device mesh rule (r09, re-pinned r13): on meshes with more than one
-device, ``impl in (None, "auto")`` resolves to the fused-XLA tail — the
-kernel's grid slices the slot axis, which is exactly the sharded mesh
-axis, so SPMD would all-gather the ``(n_slots, V)`` logits plane into the
-decode hot loop. Still bit-exact (same gumbel/add/argmax); an explicit
-``"pallas"`` request is honored. The rule must also hold inside the
-speculative-decoding verify forward, whose K-event window samples every
-head through the same tail: the committed ``engine_spec_verify_dp8``
-collective budget pins zero new collective kinds vs the baseline decode
-(``tests/test_graftcheck.py::TestTierB::
-test_spec_verify_budget_has_no_new_collective_kinds``), i.e. no
-logits-plane gather ever reaches the verify hot loop.
+Multi-device mesh rule (r09, retired r20): the r09 rule forced ``impl in
+(None, "auto")`` to the fused-XLA tail on any multi-device mesh, because
+the kernel's grid slices the slot axis — exactly the sharded mesh axis —
+so plain SPMD lowering would all-gather the ``(n_slots, V)`` logits plane
+into the decode hot loop. r20 retires that fallback on data-sharded
+meshes: the engine now wraps the whole vmapped sampling call in
+``shard_map`` over the ``data`` axis, so each device runs the kernel grid
+on its own slot shard and the logits plane never crosses the mesh — the
+committed ``engine_sampling_shard_dp8`` budget pins zero collectives in
+the sharded decode tail (no slot-plane gather, "zero new collective
+kinds" vs ``engine_dp8``). Per-shard draws are bit-identical to the
+unsharded kernel's (the gumbel fold is per-row), so the engine's
+``generate()`` parity contract survives sharding. The one surviving
+fallback: tensor-parallel meshes keep the fused-XLA tail, because the
+vocab axis itself may be ``model``-sharded and the per-row kernel would
+force an all-gather of every head's logits. The speculative-decoding
+verify forward samples every head through the same tail; the committed
+``engine_spec_verify_dp8`` budget still pins zero new collective kinds vs
+the baseline decode (``tests/test_graftcheck.py::TestTierB::
+test_spec_verify_budget_has_no_new_collective_kinds``).
 """
 
 from __future__ import annotations

@@ -303,6 +303,13 @@ class PrefillHandoff:
     budgets: Any = None  # (g,) per-row max_new_events
     keys: Any = None  # (g, 2) post-prefill PRNG chains
     first_event_real: Any = None  # (g,) bool
+    # Spec engines only (r20, spec x prefill stream): the draft model's
+    # prefilled cache rows — the handoff carries the draft cache seed so
+    # the decode replica's admit lands BOTH chains in one scatter — and,
+    # for NA targets, the per-layer history head of each prompt's last
+    # event. None on non-spec handoffs.
+    draft_caches: Any = None
+    draft_history: Any = None
 
 
 def _as_raw_key(key) -> jnp.ndarray:
@@ -405,10 +412,15 @@ class GenerationEngine:
             ``greedy=True`` spec mode with zero value tolerances commits
             only the target's own greedy draws — structure/integers
             bit-identical to the greedy non-speculative engine, floats
-            within the documented last-ulp fusion envelope. Unsupported
-            beside ``top_k``/``top_p``
-            filtering, custom ``device_criteria``, serve-time tensor
-            parallelism, and the dedicated prefill stream (loud errors).
+            within the documented last-ulp fusion envelope (widening to
+            the `ops.kv_quant` tolerance envelope under a quantized
+            ``kv_cache_dtype``). Composes with ``top_k``/``top_p``
+            filtering (the accept rule runs over the same filtered pmfs
+            the draws come from), serve-time tensor parallelism, the
+            quantized KV cache, and the dedicated prefill stream
+            (docs/serving.md "The composition matrix"); unsupported
+            beside custom ``device_criteria`` and ``paged_kv``
+            (loud errors).
         greedy: deterministic decoding — every head takes its greedy
             statistic (categorical mode, Bernoulli >= 0.5, continuous
             mean) instead of sampling. The PRNG chain is untouched.
@@ -440,6 +452,14 @@ class GenerationEngine:
             cursor, dequantized on read inside the attention contraction
             (`ops.kv_quant`; docs/serving.md "Quantized decode cache" for
             the tolerance contract and the slots-per-chip math).
+        decode_step_impl: the CI decode inner-step implementation.
+            ``None``/``"auto"`` run the A/B-measured production default
+            (fused XLA); ``"pallas"``/``"pallas_interpret"`` route the
+            whole layer stack through the fused decode megakernel
+            (`ops.pallas_decode_step`; docs/performance.md "The decode
+            megakernel" for the fusion boundary and when each side wins).
+            NA models, paged caches, spec, scan_layers checkpoints and
+            serving meshes raise loudly here (issue #21).
     """
 
     def __init__(
@@ -469,6 +489,7 @@ class GenerationEngine:
         block_size: int = 16,
         num_blocks: int | None = None,
         spec: Optional[SpecConfig] = None,
+        decode_step_impl: str | None = None,
         greedy: bool = False,
         health_sentinel: bool = True,
         health_retries: int = 0,
@@ -549,23 +570,34 @@ class GenerationEngine:
                 )
             self._categorical_sampler = None
             self.sampling_impl_resolved = "multi_op"
+            self._shard_sampling = False
         else:
             from ..ops.fused_sampling import fused_categorical
             from ..ops.impl_select import resolve_impl
 
             impl = sampling_impl
-            if impl in (None, "auto") and mesh is not None and mesh.devices.size > 1:
-                # The sampling kernel's grid slices the slot axis, which is
-                # exactly the sharded mesh axis: SPMD would all-gather the
-                # (n_slots, V) logits plane into the decode hot loop
-                # (caught by the engine_kvq_dp8 budget gate). Auto falls
-                # back to the fused-XLA tail on multi-device meshes — still
-                # bit-exact; an explicit "pallas" request is honored.
+            if impl in (None, "auto") and self.tensor_parallel:
+                # Tensor-parallel meshes keep the fused-XLA tail: GSPMD may
+                # leave the head logits' vocab axis sharded over `model`,
+                # and a slot-axis shard_map over that layout would gather
+                # the plane. Pure-data meshes no longer degrade — see the
+                # shard_map routing below (r20, retiring the r09 mesh rule).
                 impl = "xla"
             # Resolve eagerly (freezing the env/backend choice at engine
             # construction) so stats()/bench can report WHICH tail actually
-            # runs — "fused_auto" would hide the mesh degrade above.
+            # runs — "fused_auto" would hide the TP degrade above.
             impl = resolve_impl(impl, "fused_categorical")
+            # r20: on multi-device data meshes the kernel's grid runs UNDER
+            # `shard_map` over the slot ('data') axis — each device sweeps
+            # its own (n_slots/dp, V) logits shard, so no gather ever
+            # reaches the decode hot loop (pinned by the
+            # engine_sampling_shard_dp8 collective budget). This retires
+            # the r09 "fall back to fused-XLA on any mesh" rule.
+            self._shard_sampling = (
+                impl in ("pallas", "pallas_interpret")
+                and mesh is not None
+                and int(mesh.shape["data"]) > 1
+            )
             self.sampling_impl_resolved = f"fused_{impl}"
             self._categorical_sampler = functools.partial(
                 fused_categorical,
@@ -596,12 +628,6 @@ class GenerationEngine:
         self.draft_params = None
         if spec is not None:
             spec.validate_against(config)
-            if self.top_k is not None or self.top_p is not None:
-                raise ValueError(
-                    "speculative decoding does not compose with top_k/top_p "
-                    "filtering: the accept rule needs the heads' unfiltered "
-                    "densities (filtered-pmf support is a follow-up)"
-                )
             if self.device_criteria:
                 raise ValueError(
                     "speculative decoding supports the built-in per-row stops "
@@ -609,18 +635,15 @@ class GenerationEngine:
                     "device_criteria cannot be re-evaluated per committed "
                     "prefix inside the verify program"
                 )
-            if self.tensor_parallel:
-                raise ValueError(
-                    "speculative decoding on tensor-parallel serve meshes is "
-                    "not supported yet; shard slots over 'data' only"
-                )
-            if self._kv_quantized:
-                raise ValueError(
-                    "speculative decoding with a quantized KV cache is not "
-                    "supported: the verify window re-reads freshly written "
-                    "positions, which must be exact for the greedy bit-identity "
-                    "contract"
-                )
+            # r20 composition closure: top_k/top_p filtering (the accept
+            # rule runs over the filtered-and-renormalized pmfs —
+            # spec.spec_accept_level "Filtered pmfs"), serve-time tensor
+            # parallelism (verify/draft programs pin out_shardings to the
+            # input layout like the baseline decode), and quantized KV
+            # caches (draft AND target quantize-on-write; the greedy
+            # bit-identity contract relaxes to the r09 kv_quant envelope
+            # on floats, structure/integers still exact) now compose here
+            # instead of raising.
             self.draft_params = spec.params
             if self._is_na and getattr(config, "scan_layers", False):
                 raise ValueError(
@@ -662,14 +685,21 @@ class GenerationEngine:
                     "paged KV cache does not compose with speculative decoding "
                     "yet: the verify window re-reads freshly written positions "
                     "through the draft/target cache pair, which still admits "
-                    "monolithically; drop spec or paged_kv"
+                    "monolithically (tracked as ROADMAP item 3, composition "
+                    "closure — the paged x spec cell; issue #21). Nearest "
+                    "supported configurations: spec with monolithic caches "
+                    "(kv_cache_dtype='int8' composes, r20), or paged_kv "
+                    "without spec (fork() branched rollouts)"
                 )
             if self.tensor_parallel:
                 raise ValueError(
                     "paged KV cache on tensor-parallel serve meshes is not "
                     "supported: the block pool replicates over the mesh, which "
-                    "would defeat the model-axis KV sharding; shard slots over "
-                    "'data' only"
+                    "would defeat the model-axis KV sharding (tracked as "
+                    "ROADMAP item 3, composition closure — the paged x TP "
+                    "cell; issue #21). Nearest supported configurations: "
+                    "monolithic caches with TP (spec x int8 x TP composes, "
+                    "r20), or paged_kv on a pure-'data' mesh"
                 )
             if self.block_size < 1 or self.max_len % self.block_size != 0:
                 raise ValueError(
@@ -700,6 +730,76 @@ class GenerationEngine:
             self._tables = np.zeros((self.n_slots, blocks_per_slot), np.int32)
         elif num_blocks is not None:
             raise ValueError("num_blocks requires paged_kv=True")
+
+        # r20 decode megakernel (ops/pallas_decode_step.py): fuse the CI
+        # decode inner step — per-layer LN/qkv/cursor-write/attention/MLP +
+        # the between-layer event-mask zeroing — into one persistent Pallas
+        # kernel. `auto` resolves to the A/B-measured production default
+        # (fused XLA; bench.py `decode_step_impl_winner` names it, the r06
+        # discipline), so the kernel is explicit opt-in; the interpret mode
+        # is the CI parity gate. Composition matrix (docs/serving.md): kvq
+        # and hot-swap compose; NA / paged / spec / scan_layers / meshes
+        # are loud errors below (issue #21 tracks the closure).
+        self.decode_step_impl = decode_step_impl
+        if decode_step_impl in (None, "auto"):
+            self._decode_step_resolved = "xla"
+        elif decode_step_impl in ("pallas", "pallas_interpret", "xla"):
+            self._decode_step_resolved = decode_step_impl
+        else:
+            raise ValueError(
+                f"decode_step_impl must be one of None/'auto'/'pallas'/"
+                f"'pallas_interpret'/'xla', got {decode_step_impl!r}"
+            )
+        if self._decode_step_resolved != "xla":
+            if self._is_na:
+                raise ValueError(
+                    "the decode megakernel fuses the CI one-event step only; "
+                    "nested-attention decode walks the per-event dep-graph "
+                    "levels through their own fused kernels "
+                    "(ops/pallas_dep_graph.py) and does not route through it "
+                    "(tracked as ROADMAP item 3, composition closure — the "
+                    "megakernel x NA cell; issue #21). Nearest supported "
+                    "configuration: CI engines with decode_step_impl set, or "
+                    "NA engines with decode_step_impl='xla'"
+                )
+            if spec is not None:
+                raise ValueError(
+                    "speculative decoding replaces the decode step with the "
+                    "draft-chunk/verify program pair, which the megakernel "
+                    "does not fuse yet (tracked as ROADMAP item 3, "
+                    "composition closure — the megakernel x spec cell; issue "
+                    "#21). Nearest supported configurations: spec with "
+                    "decode_step_impl='xla' (the fused sampling tail still "
+                    "applies), or the megakernel without spec"
+                )
+            if self.paged_kv:
+                raise ValueError(
+                    "the decode megakernel reads the monolithic (B, H, M, D) "
+                    "cache planes; the paged pool's block-table indirection "
+                    "is not fused yet (tracked as ROADMAP item 3, "
+                    "composition closure — the megakernel x paged cell; "
+                    "issue #21). Nearest supported configurations: "
+                    "monolithic caches (kv_cache_dtype='int8' composes), or "
+                    "paged_kv with decode_step_impl='xla'"
+                )
+            if getattr(config, "scan_layers", False):
+                raise ValueError(
+                    "the decode megakernel stacks the unrolled h{i} layer "
+                    "params into its leading grid axis; scan_layers "
+                    "checkpoints store the stacked h_scan layout instead — "
+                    "migrate with models.transformer.unstack_layer_params "
+                    "(or run with decode_step_impl='xla')"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "the decode megakernel is single-device for now: its "
+                    "layer grid is not yet shard_mapped over the slot/model "
+                    "mesh axes (tracked as ROADMAP item 3, composition "
+                    "closure — the megakernel x mesh cell; issue #21). "
+                    "Nearest supported configurations: an unsharded engine "
+                    "with the megakernel, or a mesh with "
+                    "decode_step_impl='xla'"
+                )
 
         self.scheduler = Scheduler(
             self.n_slots,
@@ -781,18 +881,35 @@ class GenerationEngine:
             out_shardings=self._state_out_shardings,
         )
         if spec is not None:
-            self._spec_draft_jit = jax.jit(
-                self._spec_draft_chunk_na if self._is_na else self._spec_draft_chunk_ci,
-                donate_argnums=(1, 2),
+            draft_fn = (
+                self._spec_draft_chunk_na if self._is_na else self._spec_draft_chunk_ci
             )
+            verify_fn = self._spec_verify_na if self._is_na else self._spec_verify_ci
+            spec_draft_out = spec_verify_out = None
+            if self.tensor_parallel:
+                # Same Tier C donation-drop fix as the baseline decode: pin
+                # the output state (and the proposal buffers, whose slot
+                # plane rides axis 1) to the input layout so GSPMD cannot
+                # reshard small replicated leaves over `model` and silently
+                # drop their donation.
+                st_sh = self._state_out_shardings
+                sp_sh = self._tree_shardings(self._spec_state)
+                _, _, prop_shape = jax.eval_shape(
+                    draft_fn, self.draft_params, self._state, self._spec_state
+                )
+                prop_sh = jax.tree_util.tree_map(
+                    self._spec_proposal_sharding, prop_shape
+                )
+                spec_draft_out = (st_sh, sp_sh, prop_sh)
+                spec_verify_out = (st_sh, sp_sh)
+            self._spec_draft_jit = jax.jit(draft_fn, donate_argnums=(1, 2),
+                                           out_shardings=spec_draft_out)
             # The proposal buffers (arg 3) are consumed here but alias no
             # output shape, so donating them would be a no-op the Tier C
             # donation audit rightly flags; they die after the call either
             # way.
-            self._spec_verify_jit = jax.jit(
-                self._spec_verify_na if self._is_na else self._spec_verify_ci,
-                donate_argnums=(1, 2),
-            )
+            self._spec_verify_jit = jax.jit(verify_fn, donate_argnums=(1, 2),
+                                            out_shardings=spec_verify_out)
         self._prefill_jits: dict[tuple[int, int], Any] = {}
         self._prefill_fork_fwd_jits: dict[int, Any] = {}
         self._prefill_fork_admit_jits: dict[int, Any] = {}
@@ -802,6 +919,11 @@ class GenerationEngine:
         # scatter alone (runs on the decode replica receiving the handoff).
         self._prefill_compute_jits: dict[tuple[int, int], Any] = {}
         self._admit_jits: dict[int, Any] = {}
+        # Spec flavors of the split pair: the compute half adds the draft
+        # model's prompt forward (the handoff's draft cache seed), the
+        # admit half lands both chains in one program (r20).
+        self._prefill_compute_spec_jits: dict[tuple[int, int], Any] = {}
+        self._admit_spec_jits: dict[int, Any] = {}
         self._extract_jits: dict[int, Any] = {}
         # Packs done/cursor/base_len/n_generated (+ the health row) into ONE
         # (5, n_slots) array so the boundary readback is a single async host
@@ -947,13 +1069,19 @@ class GenerationEngine:
 
         The draft caches share the target's ``max_len`` (positions must
         align between the two chains) at the draft's own width/depth — the
-        capacity cost `slots_report` accounts per slot.
+        capacity cost `slots_report` accounts per slot. They also share the
+        target's ``kv_cache_dtype``: under a quantized cache the draft
+        quantizes on write/admission through the exact same branches the
+        target does (the scale tables ride beside the planes), which is
+        what makes the spec x int8 slots-per-chip math compose.
         """
         S, L = self.n_slots, self.max_len
         dcfg = self.spec.config
         seq = tuple(
             kv.replace(length=jnp.zeros((S,), jnp.int32))
-            for kv in init_kv_caches(dcfg, S, max_len=L)
+            for kv in init_kv_caches(
+                dcfg, S, max_len=L, cache_dtype=self.kv_cache_dtype
+            )
         )
         if self._is_na:
             n_levels = len(self._measurements_to_fill_list)
@@ -998,7 +1126,57 @@ class GenerationEngine:
     def _state_shardings(self):
         return self._tree_shardings(self._state)
 
+    def _spec_proposal_sharding(self, x):
+        """Sharding for one stacked proposal leaf: the draft chunk stacks
+        K per-event leaves, so the slot plane is axis 1 — ``(K, S, ...)``
+        shards over ('data',) on axis 1; anything else replicates."""
+        mesh = self.mesh
+        if getattr(x, "ndim", 0) >= 2 and x.shape[1] == self.n_slots:
+            return NamedSharding(mesh, P(None, "data", *([None] * (x.ndim - 2))))
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == self.n_slots:
+            return NamedSharding(mesh, P("data", *([None] * (x.ndim - 1))))
+        return NamedSharding(mesh, P())
+
     # --------------------------------------------------------- device pieces
+    def _shard_rows(self, fn, *args):
+        """Runs a row-vmapped sampling call under `shard_map` over the slot
+        ('data') mesh axis when the sharded kernel tail is active
+        (``_shard_sampling``).
+
+        Each device then sweeps only its own ``(n_slots/dp, V)`` logits
+        shard — the Pallas grid never crosses the mesh axis, so SPMD
+        inserts no logits-plane gather into the decode hot loop (the r20
+        rule retiring the r09 "fall back to fused-XLA on any mesh"
+        fallback; pinned by the ``engine_sampling_shard_dp8`` collective
+        budget). Calls whose rows are not the slot plane (prefill groups,
+        replicated planes) skip the wrap and run replicated, exactly as
+        before.
+        """
+        if not self._shard_sampling:
+            return fn(*args)
+        S = self.n_slots
+
+        def _rowwise(x):
+            return getattr(x, "ndim", 0) >= 1 and x.shape[0] == S
+
+        in_leaves = jax.tree_util.tree_leaves(args)
+        if not in_leaves or not all(_rowwise(x) for x in in_leaves):
+            return fn(*args)
+        out_shape = jax.eval_shape(fn, *args)
+        if not all(_rowwise(x) for x in jax.tree_util.tree_leaves(out_shape)):
+            return fn(*args)
+        from jax.experimental.shard_map import shard_map
+
+        row_spec = lambda x: P("data", *([None] * (x.ndim - 1)))  # noqa: E731
+        wrapped = shard_map(
+            fn,
+            mesh=self.mesh,
+            in_specs=jax.tree_util.tree_map(row_spec, args),
+            out_specs=jax.tree_util.tree_map(row_spec, out_shape),
+            check_rep=False,
+        )
+        return wrapped(*args)
+
     def _sample_rows(self, preds_last, em_last, step_keys, active=None):
         """Per-slot sampling with per-slot keys: each row draws exactly what a
         B=1 ``generate()`` with that key would (vmapped `sample_predictions`).
@@ -1021,13 +1199,15 @@ class GenerationEngine:
             row = lambda p, e, k: sample_predictions(  # noqa: E731
                 p, e, k, categorical_sampler=base
             )
-            return jax.vmap(row)(preds_last, em_last, step_keys)
+            return self._shard_rows(jax.vmap(row), preds_last, em_last, step_keys)
 
         def row_active(p, e, k, a):
             sampler = functools.partial(base, active=a)
             return sample_predictions(p, e, k, categorical_sampler=sampler)
 
-        return jax.vmap(row_active)(preds_last, em_last, step_keys, active)
+        return self._shard_rows(
+            jax.vmap(row_active), preds_last, em_last, step_keys, active
+        )
 
     def _draw_rows(self, preds_last, keys):
         """Per-row raw named-head draws (`sample_head_draws`) — the spec
@@ -1039,7 +1219,9 @@ class GenerationEngine:
         row = lambda p, k: sample_head_draws(  # noqa: E731
             p, k, categorical_sampler=None if greedy else base, greedy=greedy
         )
-        return jax.vmap(row)(preds_last, keys)
+        if greedy or base is None:
+            return jax.vmap(row)(preds_last, keys)
+        return self._shard_rows(jax.vmap(row), preds_last, keys)
 
     def _row_done(self, big, cursor, base_len, n_generated, budget):
         done = (cursor - base_len) >= budget
@@ -1128,15 +1310,87 @@ class GenerationEngine:
             return NAPast(seq_past=seq, dep_graph_past=new.dep_graph_past)
         return self._merge_rows(active, new, old)
 
+    def _mega_apply(self, params, view, caches):
+        """The CI decode forward through the fused decode-step megakernel.
+
+        Splits ``model.apply`` at its natural seams: the input layer and
+        the ``ln_f`` + output-layer epilogue run as ordinary flax
+        submodule applies on the SAME param subtrees the full model uses,
+        while the entire layer stack between them runs as one
+        `ops.pallas_decode_step.decode_stack_step` call. Weights restack
+        inside the jit from the ``params`` argument, so hot-swap flips
+        keep working; quantized caches pass their scale tables through
+        and quantize-on-write inside the kernel (`ops.kv_quant` parity).
+        Returns the same `GenerativeSequenceModelOutput` shape the model
+        call yields (preds + refreshed per-layer cache tuple).
+        """
+        import flax.linen as nn
+
+        from ..models.ci_model import (
+            ConditionallyIndependentGenerativeOutputLayer,
+        )
+        from ..models.transformer import (
+            ConditionallyIndependentPointProcessInputLayer,
+        )
+        from ..ops.pallas_decode_step import decode_stack_step, stack_layer_weights
+
+        cfg = self.config
+        p = params["params"]
+        enc = p["encoder"]
+        embeds = ConditionallyIndependentPointProcessInputLayer(cfg).apply(
+            {"params": enc["input_layer"]}, view
+        )
+        quantized = caches[0].key_scale is not None
+        windows = tuple(
+            cfg.seq_window_size if t == "local" else 0
+            for t in cfg.seq_attention_layers
+        )
+        h, nkc, nvc, nks, nvs, nmask, nlen = decode_stack_step(
+            stack_layer_weights(enc, cfg.num_hidden_layers),
+            jnp.stack([c.key for c in caches]),
+            jnp.stack([c.value for c in caches]),
+            jnp.stack([c.key_scale for c in caches]) if quantized else None,
+            jnp.stack([c.value_scale for c in caches]) if quantized else None,
+            embeds[:, 0, :],
+            caches[0].length,
+            view.event_mask[:, 0],
+            caches[0].mask,
+            windows=windows,
+            activation=cfg.activation_function,
+            layer_norm_eps=float(cfg.layer_norm_epsilon),
+            impl=self._decode_step_resolved,
+        )
+        encoded = nn.LayerNorm(
+            epsilon=cfg.layer_norm_epsilon, dtype=cfg.compute_dtype
+        ).apply({"params": enc["ln_f"]}, h[:, None, :])
+        out = ConditionallyIndependentGenerativeOutputLayer(cfg).apply(
+            {"params": p["output_layer"]}, view, encoded, is_generation=True
+        )
+        new_caches = tuple(
+            KVCache(
+                key=nkc[i],
+                value=nvc[i],
+                mask=nmask,
+                length=nlen,
+                key_scale=None if nks is None else nks[i],
+                value_scale=None if nvs is None else nvs[i],
+            )
+            for i in range(cfg.num_hidden_layers)
+        )
+        return out.replace(past_key_values=new_caches)
+
     # CI decode: one event per slot per step, scanned decode_chunk times.
     def _decode_step_ci(self, params, st: SlotState) -> SlotState:
         config = self.config
         active = st.live & ~st.done
         new_keys, step_keys = _vmap_split(st.keys)
         view = _trim_to_event(st.big, st.cursor - 1)
-        out = self.model.apply(
-            params, view, past=st.caches, use_cache=True, is_generation=True
-        )
+        if self._decode_step_resolved != "xla":
+            out = self._mega_apply(params, view, st.caches)
+        else:
+            out = self.model.apply(
+                params, view, past=st.caches, use_cache=True, is_generation=True
+            )
         preds_last = _slice_preds_at(out.preds, jnp.asarray(0))
         em_last = take_event(st.big.event_mask, st.cursor - 1)
         sample = self._sample_rows(preds_last, em_last, step_keys, active=active)
@@ -1487,6 +1741,8 @@ class GenerationEngine:
             greedy=self.greedy,
             rtol=self.spec.value_rtol,
             atol=self.spec.value_atol,
+            top_k=self.top_k,
+            top_p=self.top_p,
         )
         accepts, cands = [], []
         for t in range(1, K + 1):
@@ -1585,6 +1841,8 @@ class GenerationEngine:
             greedy=self.greedy,
             rtol=self.spec.value_rtol,
             atol=self.spec.value_atol,
+            top_k=self.top_k,
+            top_p=self.top_p,
         )
         acc_events, lrejs = [], []
         level_cands = [[] for _ in range(n_levels)]
@@ -1874,6 +2132,63 @@ class GenerationEngine:
                 fn, donate_argnums=(0,), out_shardings=self._state_out_shardings
             )
         return self._admit_jits[group]
+
+    def _prefill_compute_spec_jit(self, bucket_len: int, group: int):
+        """The spec-mode prefill forward WITHOUT the slot scatters: the
+        target's bucketed prefill on the per-event-index chain PLUS the
+        draft model's prompt forward — the compute half a dedicated
+        prefill replica runs for a speculative target tier. The handoff
+        carries the draft cache seed (`PrefillHandoff.draft_caches`), so
+        both chains admit on the decode replica in one program."""
+        key = (bucket_len, group)
+        if key not in self._prefill_compute_spec_jits:
+
+            def fn(params, draft_params, pbig, plen, keys):
+                if self._is_na:
+                    big1, caches1, fer, history1 = self._prefill_forward_na_spec(
+                        bucket_len, params, pbig, plen, keys
+                    )
+                else:
+                    big1, caches1, fer = self._prefill_forward_ci_spec(
+                        bucket_len, params, pbig, plen, keys
+                    )
+                    history1 = None
+                dcaches1 = self._prefill_draft_forward(
+                    bucket_len, draft_params, pbig, big1, plen
+                )
+                return big1, caches1, fer, dcaches1, history1
+
+            self._prefill_compute_spec_jits[key] = jax.jit(fn)
+        return self._prefill_compute_spec_jits[key]
+
+    def _admit_spec_jit(self, group: int):
+        """Both chains' admit scatters as ONE program: the target's row
+        scatter (quantize-on-admission under a quantized cache dtype) and
+        the draft cache + spec-counter scatter. Donates both state trees;
+        TP layouts pin outputs to the input layout (Tier C fix)."""
+        if group not in self._admit_spec_jits:
+
+            def fn(
+                state, sp, big1, caches1, plen, budgets, keys1,
+                first_event_real, dcaches1, history1, slots,
+            ):
+                state = self._admit(
+                    state, big1, caches1, plen, budgets, keys1, slots,
+                    first_event_real=first_event_real,
+                )
+                sp = self._admit_draft(sp, dcaches1, plen, slots, history1=history1)
+                return state, sp
+
+            spec_out = None
+            if self.tensor_parallel:
+                spec_out = (
+                    self._state_out_shardings,
+                    self._tree_shardings(self._spec_state),
+                )
+            self._admit_spec_jits[group] = jax.jit(
+                fn, donate_argnums=(0, 1), out_shardings=spec_out
+            )
+        return self._admit_spec_jits[group]
 
     def _prefill_forward_ci(self, Lb, params, pbig, plen, keys):
         """The bucketed prefill forward + first-event sample, WITHOUT the
@@ -2221,7 +2536,16 @@ class GenerationEngine:
                 self._prefill_spec_na if self._is_na else self._prefill_spec_ci,
                 bucket_len,
             )
-            self._prefill_spec_jits[key] = jax.jit(fn, donate_argnums=(2, 3))
+            spec_out = None
+            if self.tensor_parallel:
+                # Tier C donation-drop fix, spec flavor (constructor note).
+                spec_out = (
+                    self._state_out_shardings,
+                    self._tree_shardings(self._spec_state),
+                )
+            self._prefill_spec_jits[key] = jax.jit(
+                fn, donate_argnums=(2, 3), out_shardings=spec_out
+            )
         return self._prefill_spec_jits[key]
 
     def _prefill_draft_forward(self, Lb, draft_params, pbig, big1, plen):
@@ -2676,13 +3000,6 @@ class GenerationEngine:
         engines, and a key derived from THIS engine's base key would break
         the target's determinism contract (the service/fleet assign keys at
         accept time, so theirs always do)."""
-        if self.spec is not None:
-            raise NotImplementedError(
-                "speculative engines do not serve behind a dedicated prefill "
-                "stream yet: the handoff would need the draft model's cache "
-                "rows (and the stream replica the draft weights); use the "
-                "budget-capped local prefill path (prefill_budget_events)"
-            )
         if self.paged_kv:
             raise NotImplementedError(
                 "paged engines do not serve behind a dedicated prefill "
@@ -2699,6 +3016,26 @@ class GenerationEngine:
                     "cross-engine handoff"
                 )
         stacked, plen, budgets, keys = self._group_arrays(requests, group)
+        if self.spec is not None:
+            # Spec chain: the first generated event draws under
+            # fold_in(request_key, 0) and the request keys never advance;
+            # the handoff additionally carries the draft cache seed (r20,
+            # spec x prefill stream).
+            big1, caches1, fer, dcaches1, history1 = self._prefill_compute_spec_jit(
+                bucket_len, group
+            )(self.params, self.draft_params, stacked, plen, keys)
+            return PrefillHandoff(
+                requests=list(requests),
+                group=group,
+                big=big1,
+                caches=caches1,
+                plen=plen,
+                budgets=budgets,
+                keys=keys,
+                first_event_real=fer,
+                draft_caches=dcaches1,
+                draft_history=history1,
+            )
         big1, caches1, keys1, fer = self._prefill_compute_jit(bucket_len, group)(
             self.params, stacked, plen, keys
         )
@@ -2726,17 +3063,39 @@ class GenerationEngine:
         n, g = len(handoff.requests), handoff.group
         if len(slots) != n:
             raise ValueError(f"{n} handoff rows need {n} slots, got {len(slots)}")
+        if (handoff.draft_caches is not None) != (self.spec is not None):
+            raise ValueError(
+                "prefill-stream handoff/engine spec-mode mismatch: a "
+                "speculative decode replica needs the draft cache seed in "
+                "the handoff (and a non-spec replica cannot admit one) — "
+                "pair spec targets with a spec-configured prefill stream"
+            )
         slots_arr = jnp.asarray(list(slots) + [self.n_slots] * (g - n), jnp.int32)
-        self._state = self._admit_jit(g)(
-            self._state,
-            handoff.big,
-            handoff.caches,
-            handoff.plen,
-            handoff.budgets,
-            handoff.keys,
-            handoff.first_event_real,
-            slots_arr,
-        )
+        if self.spec is not None:
+            self._state, self._spec_state = self._admit_spec_jit(g)(
+                self._state,
+                self._spec_state,
+                handoff.big,
+                handoff.caches,
+                handoff.plen,
+                handoff.budgets,
+                handoff.keys,
+                handoff.first_event_real,
+                handoff.draft_caches,
+                handoff.draft_history,
+                slots_arr,
+            )
+        else:
+            self._state = self._admit_jit(g)(
+                self._state,
+                handoff.big,
+                handoff.caches,
+                handoff.plen,
+                handoff.budgets,
+                handoff.keys,
+                handoff.first_event_real,
+                slots_arr,
+            )
         for r, s in zip(handoff.requests, slots):
             self._table[s] = r
             self._slot_epoch[s] = self._dispatched_chunks
@@ -3374,7 +3733,9 @@ class GenerationEngine:
             "block_pool_frees_total": a.frees_total,
         }
 
-    def _paged_report(self, branch_factor: int = 1) -> dict:
+    def _paged_report(
+        self, branch_factor: int = 1, pool_budget_bytes: int | None = None
+    ) -> dict:
         """Block-granular capacity accounting for the paged engine.
 
         ``effective_slots`` is MEASURED from the resident block tables:
@@ -3409,7 +3770,18 @@ class GenerationEngine:
         # Prefix-dominated analytic bound: a full-table tenant whose prompt
         # prefix (all but one block) is shared B ways.
         per_branch = (T - 1) / B + 1
+        # Budget-aware pool sizing: how many blocks an ``hbm_gb`` budget
+        # could hold net of weights. The budget arrives from `slots_report`
+        # with hot-swap params already doubled EXACTLY ONCE (the shadow
+        # buffer is one extra copy, reserved for the swap lifetime) — this
+        # report must never re-double it, and `pool_bytes` itself (the
+        # allocated pool) is invariant to hot_swap.
+        budget_blocks = (
+            None if pool_budget_bytes is None else int(pool_budget_bytes // bpb)
+        )
         return {
+            "pool_budget_bytes": pool_budget_bytes,
+            "max_pool_blocks_in_budget": budget_blocks,
             "block_size": self.block_size,
             "num_blocks": a.num_blocks,
             "blocks_per_slot": T,
@@ -3496,12 +3868,17 @@ class GenerationEngine:
                 x.nbytes for x in jax.tree_util.tree_leaves(self.draft_params)
             )
             dcfg = self.spec.config
+            # The draft rows share the engine's cache dtype (they quantize
+            # on write exactly like the target's — `_init_spec_state`), so
+            # they are charged at the ACTIVE cache dtype, not the draft's
+            # float compute dtype: under spec x int8 the old float estimate
+            # overcharged every slot and understated max_slots.
             draft_kv_bytes = kv_cache_bytes_per_slot(
                 dcfg.num_hidden_layers,
                 dcfg.num_attention_heads,
                 max_len,
                 dcfg.head_dim,
-                cache_dtype_name(dcfg.compute_dtype),
+                cache_dtype_name(self._kv_buf_dtype),
                 dcfg.compute_dtype,
             )
         if self.hot_swap:
@@ -3533,7 +3910,9 @@ class GenerationEngine:
             per_dtype["bf16"]["max_slots"], 1
         )
         paged = (
-            self._paged_report(branch_factor=branch_factor)
+            self._paged_report(
+                branch_factor=branch_factor, pool_budget_bytes=budget
+            )
             if self.paged_kv
             else None
         )
@@ -3567,6 +3946,7 @@ class GenerationEngine:
                 "active_slot_steps": active,
                 "wasted_decode_frac": round(1.0 - active / max(total, 1), 4),
                 "sampling_impl": self.sampling_impl_resolved,
+                "decode_step_impl": self._decode_step_resolved,
                 "greedy": self.greedy,
                 "health_sentinel": self.health_sentinel,
                 "health_quarantined_total": self._health_quarantined,
@@ -3646,12 +4026,6 @@ class GenerationEngine:
         keys = jnp.zeros((group, 2), jnp.uint32)
         slots = jnp.arange(group, dtype=jnp.int32)
         if self.spec is not None:
-            if include_prefill_stream:
-                raise NotImplementedError(
-                    "speculative engines do not serve behind a dedicated "
-                    "prefill stream yet (prefill_compute); there are no "
-                    "split-prefill programs to gate"
-                )
             # Spec engines compile the draft-chunk + verify pair instead of
             # the single-event decode program; the verify program's args are
             # the draft chunk's abstract outputs (AOT lowering needs shapes
@@ -3661,7 +4035,7 @@ class GenerationEngine:
             # hot loop is exactly the regression the budget would catch.
             dc_args = (self.draft_params, self._state, self._spec_state)
             _, _, proposals = jax.eval_shape(self._spec_draft_jit, *dc_args)
-            return {
+            programs = {
                 "draft_chunk": (self._spec_draft_jit, dc_args),
                 "verify": (
                     self._spec_verify_jit,
@@ -3686,6 +4060,24 @@ class GenerationEngine:
                     (self._state, self._spec_state),
                 ),
             }
+            if include_prefill_stream:
+                # The spec split pair (r20): the scatter-free target+draft
+                # prefill a dedicated prefill replica dispatches, and the
+                # both-chains admit the decode replica runs on a handoff.
+                pc_jit = self._prefill_compute_spec_jit(bucket_len, group)
+                pc_args = (self.params, self.draft_params, pbig, plen, keys)
+                programs[f"prefill_compute_b{bucket_len}"] = (pc_jit, pc_args)
+                big1, caches1, fer, dcaches1, history1 = jax.eval_shape(
+                    pc_jit, *pc_args
+                )
+                programs["admit"] = (
+                    self._admit_spec_jit(group),
+                    (
+                        self._state, self._spec_state, big1, caches1, plen,
+                        budgets, keys, fer, dcaches1, history1, slots,
+                    ),
+                )
+            return programs
         if self.paged_kv:
             # Paged prefill programs take the host-planned block tables as
             # array arguments; any in-range physical indices lower the same
@@ -3786,6 +4178,11 @@ def _census_programs():
         "verify": (1, 2),
         "prefill_b8": (2, 3),
         "boundary_pack": (),
+        # The r20 spec prefill-stream split: the compute half materializes
+        # (a prefill replica ships its outputs across the handoff); the
+        # admit donates BOTH chains' states it scatters into.
+        "prefill_compute_b8": (),
+        "admit": (0, 1),
     }
     budget_keys = {
         "engine:decode": "engine_dp8",
@@ -3813,6 +4210,21 @@ def _census_programs():
         "engine_spec:prefill_b8": "engine_spec_prefill_dp8",
         "engine_spec_na:draft_chunk": "engine_spec_na_draft_1dev",
         "engine_spec_na:verify": "engine_spec_na_verify_1dev",
+        # r20 composition closure: the slot-sharded fused-sampling decode
+        # (the Pallas grid runs on each slot shard — its budget pins "no
+        # slot-plane gather") and the composed spec × int8 × TP engine on
+        # dp4×tp2 (every program's budget pins "the per-layer TP reduce
+        # pattern and nothing more" on top of the spec budgets).
+        "engine_sampling_shard:decode": "engine_sampling_shard_dp8",
+        "engine_composed:draft_chunk": "engine_composed_draft_dp4_tp2",
+        "engine_composed:verify": "engine_composed_verify_dp4_tp2",
+        "engine_composed:prefill_b8": "engine_composed_prefill_dp4_tp2",
+        "engine_composed:prefill_compute_b8": "engine_composed_prefill_compute_dp4_tp2",
+        "engine_composed:admit": "engine_composed_admit_dp4_tp2",
+        # r20 megakernel: the persistent Pallas layer-stack decode on the
+        # single-replica topology — zero collectives by construction, and
+        # the kernel body must stay callback-free in the hot loop.
+        "engine_megakernel:decode": "engine_megakernel_1dev",
     }
     out = {}
     for prefix, programs in (
@@ -3828,8 +4240,17 @@ def _census_programs():
         # variant (whole dep-graph walk verified in one fused pass).
         ("engine_spec", pc.canonical_spec_engine_programs(8)),
         ("engine_spec_na", pc.canonical_spec_engine_na_programs()),
+        # r20: the sharded-sampling engine (slot-sharded Pallas grid, int8
+        # cache) and the composed spec × int8 × TP engine with its prefill
+        # stream split — the full production composition, censused as ONE
+        # engine so every program it compiles carries committed budgets.
+        ("engine_sampling_shard", pc.canonical_sharded_sampling_engine_programs(8)),
+        ("engine_composed", pc.canonical_composed_engine_programs(4, 2)),
+        ("engine_megakernel", pc.canonical_megakernel_engine_program()),
     ):
-        spec_prefix = prefix.startswith("engine_spec")
+        # Composed engines run the spec program set (draft/verify/...), so
+        # they take the spec donation map.
+        spec_prefix = prefix.startswith(("engine_spec", "engine_composed"))
         for key, (fn, args) in programs.items():
             label = f"{prefix}:{key}"
             out[label] = CensusProgram(

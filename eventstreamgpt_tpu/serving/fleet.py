@@ -144,13 +144,43 @@ class PrefillStream:
     def attach(self, replicas: Sequence[GenerationEngine]) -> None:
         if self._targets is not None:
             raise RuntimeError("prefill stream is already attached to a service")
-        if self.engine.spec is not None or any(e.spec is not None for e in replicas):
-            raise NotImplementedError(
-                "speculative engines do not serve behind a dedicated prefill "
-                "stream yet (the handoff would need draft cache rows); use the "
-                "budget-capped local prefill path"
-            )
         for i, e in enumerate(replicas):
+            # r20 composition closure: speculative engines DO serve behind a
+            # dedicated prefill stream (the handoff carries the draft cache
+            # seed — `PrefillHandoff.draft_caches`/`draft_history`), but
+            # both tiers must run the same speculative configuration: a
+            # spec prefill hands off draft rows a non-spec decode replica
+            # has no chains for, and vice versa.
+            if (self.engine.spec is None) != (e.spec is None):
+                raise ValueError(
+                    f"prefill replica spec={self.engine.spec is not None} != "
+                    f"decode replica {i} spec={e.spec is not None} — the "
+                    "handoff carries draft cache rows exactly when both tiers "
+                    "are speculative; build both engines with the same "
+                    "SpecConfig (or neither)"
+                )
+            if self.engine.spec is not None:
+                if e.spec_signature() != self.engine.spec_signature():
+                    raise ValueError(
+                        f"prefill replica spec signature "
+                        f"{self.engine.spec_signature()} != decode replica {i} "
+                        f"{e.spec_signature()} — the draft chain the handoff "
+                        "seeds must be the one the decode replica extends "
+                        "(same k/tolerances/draft architecture)"
+                    )
+                if self.check_weights:
+                    mismatch = _params_mismatch(
+                        self.engine.draft_params, e.draft_params
+                    )
+                    if mismatch is not None:
+                        raise ValueError(
+                            f"prefill replica DRAFT weights != decode replica "
+                            f"{i} draft weights ({mismatch}) — the handed-off "
+                            "draft cache seed replays under the decode "
+                            "replica's draft model; build both engines from "
+                            "the same draft checkpoint (or pass "
+                            "check_weights=False to own the contract yourself)"
+                        )
             if e is self.engine:
                 raise ValueError(
                     "the prefill replica must be dedicated — it cannot also be "

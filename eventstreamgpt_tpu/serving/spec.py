@@ -51,6 +51,19 @@ values, the rejecting head commits its residual/coupled draw, later heads
 commit target draws) — so every verify round commits at least one exact
 target event, and an adversarially bad draft degrades to baseline
 throughput, never to wrong samples.
+
+**Filtered pmfs** (``top_k``/``top_p``): the engine's serving-quality
+filters change the law every categorical head draws from — the
+tie-inclusive filtered-and-renormalized pmf (`ops.fused_sampling
+.topk_topp_mask`). The rejection rule survives filtering because the SAME
+mask is applied to the draft's pmf ``q`` (which generated the proposal),
+the target's pmf ``p`` (which the acceptance ratio and the target re-draws
+use), and — by construction, since the residual is ``(p - q)^+`` over the
+already-filtered pmfs — the residual. The committed marginal is then
+exactly the *filtered target law* at every acceptance rate, which is the
+law the non-speculative filtered engine commits. Masked logits use the
+identical fill value as the sampling tail (``_FILTER_NEG``), so the pmf
+the accept rule integrates is bit-the-same one the draw came from.
 """
 
 from __future__ import annotations
@@ -69,6 +82,8 @@ from ..generation.sampling import (
     assemble_event_sample,
 )
 from ..models.config import StructuredTransformerConfig
+from ..ops.fused_sampling import _NEG as _FILTER_NEG
+from ..ops.fused_sampling import topk_topp_mask
 
 Array = Any
 
@@ -240,6 +255,8 @@ def spec_accept_level(
     greedy: bool,
     rtol: float,
     atol: float,
+    top_k: int | None = None,
+    top_p: float | None = None,
 ) -> tuple[Array, GenerativeSequenceModelSamples]:
     """One chain segment of the per-head accept walk, per row (vmap me).
 
@@ -263,6 +280,13 @@ def spec_accept_level(
         event_mask: the (scalar) mask the committed event carries.
         greedy: bitwise-equality acceptance against the target's greedy
             draws (no randomness anywhere).
+        top_k / top_p: the engine's tie-inclusive sampling filters. When
+            set, every single-label categorical head's accept/residual pmfs
+            are computed over the filtered-and-renormalized logits — the
+            same mask (and the same masked fill value) the draws came
+            through — so the committed marginal is exactly the filtered
+            target law (module docstring, "Filtered pmfs"). Greedy mode
+            ignores them (tie-inclusive filters always keep the argmax).
 
     Returns:
         ``(accepted, corrected)``: whether every head accepted, and the
@@ -299,11 +323,22 @@ def spec_accept_level(
                     acc = _nan_eq(x_q, x_t)
                     corr = chain(acc, x_q, x_t, x_t)
                 else:
+                    t_logits, d_logits = t_dist.logits, d_dist.logits
+                    if top_k is not None or top_p is not None:
+                        # Identical tie-inclusive mask + fill as the
+                        # sampling tail: each side's pmf is filtered by ITS
+                        # OWN mask — the law its draw actually came from.
+                        t_logits = jnp.where(
+                            topk_topp_mask(t_logits, top_k, top_p), t_logits, _FILTER_NEG
+                        )
+                        d_logits = jnp.where(
+                            topk_topp_mask(d_logits, top_k, top_p), d_logits, _FILTER_NEG
+                        )
                     lp = _combined_single_label_logpmf(
-                        None if t_obs is None else t_obs.logits, t_dist.logits
+                        None if t_obs is None else t_obs.logits, t_logits
                     )
                     lq = _combined_single_label_logpmf(
-                        None if d_obs is None else d_obs.logits, d_dist.logits
+                        None if d_obs is None else d_obs.logits, d_logits
                     )
                     acc_key = _named_key(key, f"spec_acc:{m}")  # graftcheck: allow GC003 -- _named_key IS fold_in (distinct name per purpose)
                     res_key = _named_key(key, f"spec_res:{m}")  # graftcheck: allow GC003 -- _named_key IS fold_in (distinct name per purpose)

@@ -109,6 +109,14 @@ class TestBenchTailCapture:
         "sampling_fused_ab_ms",
         "kvq_engine_events_per_sec_per_chip",
         "kvq_slots_per_chip_ratio",
+        # r20 composition/megakernel verdicts: the never-run quantized-NA
+        # decode A/B ratio (per-rung capacity detail above the marker) and
+        # the decode-megakernel A/B whose winner names the production
+        # default `decode_step_impl='auto'` resolves to (parity gated in
+        # tests/test_decode_megakernel.py).
+        "kvq_na_vs_float_ratio",
+        "decode_megakernel_ab_ms",
+        "decode_step_impl_winner",
         # r13 speculative-decoding verdicts: draft-propose/one-pass-verify
         # vs one-event-per-forward decode on identical offline requests
         # (correctness pinned by greedy parity + the per-head chi-square in

@@ -1,0 +1,362 @@
+"""Tests for the fused CI decode-step megakernel (ops/pallas_decode_step.py,
+docs/performance.md "The decode megakernel").
+
+The parity ladder, from strongest to weakest claim (the pallas_dep_graph
+discipline):
+
+* **Op level, interpret vs XLA**: both impls run the IDENTICAL jnp
+  formulation (`_layer_math`); integer planes (quantized KV, mask,
+  length) are bit-exact across impls, floats agree to the last-ulp
+  envelope (the pallas_dep_graph precedent — separate compilation
+  contexts reassociate identical math; the L-layer stack compounds the
+  dep-graph kernel's <=2 ulp to ~1e-5 relative).
+* **Op level vs the model**: the XLA variant against the real flax
+  transformer stack on the same params/caches — hidden states and cache
+  planes match to float associativity (exact on CPU fp32 in practice;
+  asserted bitwise for the cache integers, tight-tolerance floats).
+* **Engine level**: a megakernel engine reproduces the stock engine's
+  generated events: structure and every integer output (event masks,
+  sampled categories) exact, committed float values within one ulp for
+  float caches (frequently bitwise — but XLA's context-dependent fusion
+  makes a strict bitwise pin order-brittle) and within the r09 kv_quant
+  envelope for int8 caches under the interpreter.
+
+Composition guards (NA / paged / spec / scan_layers / mesh) are loud
+typed errors pinned here and enumerated in tests/test_composition.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eventstreamgpt_tpu.models.ci_model import CIPPTForGenerativeSequenceModeling
+from eventstreamgpt_tpu.models.transformer import (
+    ConditionallyIndependentPointProcessTransformer,
+    KVCache,
+)
+from eventstreamgpt_tpu.ops.pallas_decode_step import (
+    WEIGHT_NAMES,
+    decode_stack_step,
+    stack_layer_weights,
+)
+from eventstreamgpt_tpu.serving import GenerationEngine, Request
+
+from .test_generation import ci_config, make_prompt
+
+pytestmark = pytest.mark.serving
+
+MAX_LEN = 8
+
+# The op-level float envelope: identical math, reassociated across
+# compilation contexts, compounded over the layer stack (file docstring).
+ULP = dict(rtol=2e-5, atol=2e-5)
+
+
+@pytest.fixture(scope="module")
+def ci():
+    config = ci_config()
+    prompt = make_prompt(B=4, L=4)
+    model = CIPPTForGenerativeSequenceModeling(config)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    return config, model, params, prompt
+
+
+def engine_for(ci, **kw):
+    config, model, params, prompt = ci
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("decode_chunk", 2)
+    kw.setdefault("min_bucket", 2)
+    return GenerationEngine(model, params, config, template=prompt, **kw)
+
+
+def requests(prompt, n=4):
+    reqs = []
+    for i in range(n):
+        Lp = 3 if i % 2 == 0 else 4
+        reqs.append(
+            Request(
+                prompt=prompt.slice((slice(i % 4, i % 4 + 1), slice(0, Lp))),
+                max_new_events=MAX_LEN - Lp,
+                key=jax.random.fold_in(jax.random.PRNGKey(42), i),
+                request_id=i,
+            )
+        )
+    return reqs
+
+
+def by_id(results):
+    return {r.request_id: r for r in results}
+
+
+def assert_events_equal(a, b, float_tol=1e-6):
+    """Generated-event comparison: integers/structure always exact; floats
+    inside the documented envelope (one-ulp by default)."""
+    a, b = by_id(a), by_id(b)
+    assert set(a) == set(b)
+    for i in a:
+        assert a[i].n_generated == b[i].n_generated
+        for f in ("event_mask", "dynamic_indices"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a[i].batch, f)), np.asarray(getattr(b[i].batch, f))
+            )
+        for f in ("time_delta", "dynamic_values"):
+            xa = np.nan_to_num(np.asarray(getattr(a[i].batch, f)))
+            xb = np.nan_to_num(np.asarray(getattr(b[i].batch, f)))
+            np.testing.assert_allclose(xa, xb, rtol=float_tol, atol=float_tol)
+
+
+def synthetic_stack(config, B=3, M=MAX_LEN, quantized=False, seed=0):
+    """Random stacked weights + caches shaped like the engine's decode state."""
+    L, H, D, E = (
+        config.num_hidden_layers,
+        config.num_attention_heads,
+        config.head_dim,
+        config.hidden_size,
+    )
+    F = config.intermediate_size or 4 * E
+    rng = np.random.default_rng(seed)
+    shapes = {
+        "ln1_s": (L, E), "ln1_b": (L, E),
+        "wq": (L, E, E), "wk": (L, E, E), "wv": (L, E, E),
+        "wo": (L, E, E), "bo": (L, E),
+        "ln2_s": (L, E), "ln2_b": (L, E),
+        "wfc": (L, E, F), "bfc": (L, F),
+        "wpr": (L, F, E), "bpr": (L, E),
+    }
+    assert set(shapes) == set(WEIGHT_NAMES)
+    w = {
+        k: jnp.asarray(rng.standard_normal(s) * 0.3, jnp.float32)
+        for k, s in shapes.items()
+    }
+    if quantized:
+        kc = jnp.asarray(rng.integers(-127, 128, (L, B, H, M, D)), jnp.int8)
+        vc = jnp.asarray(rng.integers(-127, 128, (L, B, H, M, D)), jnp.int8)
+        ks = jnp.asarray(rng.random((L, B, H, M)) + 0.01, jnp.float32)
+        vs = jnp.asarray(rng.random((L, B, H, M)) + 0.01, jnp.float32)
+    else:
+        kc = jnp.asarray(rng.standard_normal((L, B, H, M, D)), jnp.float32)
+        vc = jnp.asarray(rng.standard_normal((L, B, H, M, D)), jnp.float32)
+        ks = vs = None
+    h0 = jnp.asarray(rng.standard_normal((B, E)), jnp.float32)
+    start = jnp.asarray(rng.integers(1, M - 1, (B,)), jnp.int32)
+    em = jnp.asarray([True] * (B - 1) + [False])
+    mask = jnp.arange(M)[None, :] < start[:, None]
+    return w, kc, vc, ks, vs, h0, start, em, mask
+
+
+class TestOpParity:
+    @pytest.mark.parametrize("quantized", [False, True], ids=["float", "int8"])
+    def test_interpret_matches_xla(self, ci, quantized):
+        """Same `_layer_math` under both impls: integers bit-equal, floats
+        inside the last-ulp envelope."""
+        config = ci[0]
+        args = synthetic_stack(config, quantized=quantized)
+        kwargs = dict(
+            windows=(0,) * config.num_hidden_layers,
+            activation=config.activation_function,
+            layer_norm_eps=float(config.layer_norm_epsilon),
+        )
+        a = decode_stack_step(*args, impl="xla", **kwargs)
+        b = decode_stack_step(*args, impl="pallas_interpret", **kwargs)
+        assert len(a) == len(b) == 7
+        for xa, xb in zip(a, b):
+            assert (xa is None) == (xb is None)
+            if xa is None:
+                continue
+            xa, xb = np.asarray(xa), np.asarray(xb)
+            if xa.dtype.kind in "biu":  # mask/length/quantized planes
+                np.testing.assert_array_equal(xa, xb)
+            else:
+                np.testing.assert_allclose(xa, xb, **ULP)
+
+    def test_local_window_layers_match(self, ci):
+        """Windowed (local) layers: the dynamic-window formulation is
+        identical across impls, and differs from the global mask."""
+        config = ci[0]
+        args = synthetic_stack(config, seed=7)
+        base = dict(
+            activation=config.activation_function,
+            layer_norm_eps=float(config.layer_norm_epsilon),
+        )
+        L = config.num_hidden_layers
+        a = decode_stack_step(*args, impl="xla", windows=(2,) * L, **base)
+        b = decode_stack_step(
+            *args, impl="pallas_interpret", windows=(2,) * L, **base
+        )
+        np.testing.assert_allclose(np.asarray(a[0]), np.asarray(b[0]), **ULP)
+        g = decode_stack_step(*args, impl="xla", windows=(0,) * L, **base)
+        assert not np.allclose(np.asarray(a[0]), np.asarray(g[0]), **ULP)
+
+    @pytest.mark.parametrize("quantized", [False, True], ids=["float", "int8"])
+    def test_xla_variant_matches_model_stack(self, ci, quantized):
+        """decode_stack_step + ln_f vs the real flax transformer on the
+        SAME params and caches: cache integer planes bitwise, floats to
+        associativity (1e-5)."""
+        config, model, params, prompt = ci
+        L, H, D = (
+            config.num_hidden_layers,
+            config.num_attention_heads,
+            config.head_dim,
+        )
+        B, M = 2, MAX_LEN
+        rng = np.random.default_rng(11)
+        if quantized:
+            mk = lambda: jnp.asarray(  # noqa: E731
+                rng.integers(-127, 128, (B, H, M, D)), jnp.int8
+            )
+            sc = lambda: jnp.asarray(rng.random((B, H, M)) + 0.01, jnp.float32)  # noqa: E731
+        else:
+            mk = lambda: jnp.asarray(  # noqa: E731
+                rng.standard_normal((B, H, M, D)), jnp.float32
+            )
+            sc = lambda: None  # noqa: E731
+        start = jnp.asarray([3, 3], jnp.int32)
+        caches = tuple(
+            KVCache(
+                key=mk(), value=mk(),
+                mask=jnp.repeat(jnp.arange(M)[None, :] < 3, B, 0),
+                length=start, key_scale=sc(), value_scale=sc(),
+            )
+            for _ in range(L)
+        )
+        from eventstreamgpt_tpu.serving.engine import _trim_to_event
+
+        view = _trim_to_event(prompt.slice((slice(0, B), slice(0, 4))), start - 1)
+        enc = params["params"]["encoder"]
+        ref = ConditionallyIndependentPointProcessTransformer(config).apply(
+            {"params": enc}, view, past=caches, use_cache=True
+        )
+        from eventstreamgpt_tpu.models.transformer import (
+            ConditionallyIndependentPointProcessInputLayer,
+        )
+
+        embeds = ConditionallyIndependentPointProcessInputLayer(config).apply(
+            {"params": enc["input_layer"]}, view
+        )
+        h, nkc, nvc, nks, nvs, nmask, nlen = decode_stack_step(
+            stack_layer_weights(enc, L),
+            jnp.stack([c.key for c in caches]),
+            jnp.stack([c.value for c in caches]),
+            jnp.stack([c.key_scale for c in caches]) if quantized else None,
+            jnp.stack([c.value_scale for c in caches]) if quantized else None,
+            embeds[:, 0, :],
+            start,
+            view.event_mask[:, 0],
+            caches[0].mask,
+            windows=(0,) * L,
+            activation=config.activation_function,
+            layer_norm_eps=float(config.layer_norm_epsilon),
+            impl="xla",
+        )
+        import flax.linen as nn
+
+        encoded = nn.LayerNorm(
+            epsilon=config.layer_norm_epsilon, dtype=config.compute_dtype
+        ).apply({"params": enc["ln_f"]}, h[:, None, :])
+        np.testing.assert_allclose(
+            np.asarray(ref.last_hidden_state),
+            np.asarray(encoded),
+            rtol=1e-5,
+            atol=1e-5,
+        )
+        for i, c in enumerate(ref.past_key_values):
+            if quantized:
+                np.testing.assert_array_equal(np.asarray(c.key), np.asarray(nkc[i]))
+                np.testing.assert_array_equal(np.asarray(c.value), np.asarray(nvc[i]))
+                np.testing.assert_allclose(
+                    np.asarray(c.key_scale), np.asarray(nks[i]), rtol=1e-6
+                )
+            else:
+                np.testing.assert_allclose(
+                    np.asarray(c.key), np.asarray(nkc[i]), rtol=1e-6, atol=1e-6
+                )
+            np.testing.assert_array_equal(np.asarray(c.mask), np.asarray(nmask))
+            np.testing.assert_array_equal(np.asarray(c.length), np.asarray(nlen))
+
+
+class TestEngineParity:
+    def test_sampled_run_float_cache(self, ci):
+        """Float caches: megakernel engines reproduce the stock engine's
+        generated events — integers exact, floats within one ulp."""
+        _, _, _, prompt = ci
+        ref = engine_for(ci).run(requests(prompt))
+        mx = engine_for(ci, decode_step_impl="xla").run(requests(prompt))
+        mi = engine_for(ci, decode_step_impl="pallas_interpret").run(
+            requests(prompt)
+        )
+        assert_events_equal(ref, mx)
+        assert_events_equal(ref, mi)
+
+    def test_greedy_run(self, ci):
+        _, _, _, prompt = ci
+        ref = engine_for(ci, greedy=True).run(requests(prompt))
+        mi = engine_for(
+            ci, greedy=True, decode_step_impl="pallas_interpret"
+        ).run(requests(prompt))
+        assert_events_equal(ref, mi)
+
+    def test_int8_cache_composes(self, ci):
+        """Quantized caches through the megakernel: the fused-XLA variant
+        stays bitwise vs stock; the interpreter keeps structure and
+        integers exact with floats inside the r09 kv_quant envelope."""
+        _, _, _, prompt = ci
+        ref = engine_for(ci, kv_cache_dtype="int8").run(requests(prompt))
+        mx = engine_for(ci, kv_cache_dtype="int8", decode_step_impl="xla").run(
+            requests(prompt)
+        )
+        mi = engine_for(
+            ci, kv_cache_dtype="int8", decode_step_impl="pallas_interpret"
+        ).run(requests(prompt))
+        assert_events_equal(ref, mx)
+        assert_events_equal(ref, mi, float_tol=1e-4)
+
+    def test_stats_reports_resolved_impl(self, ci):
+        assert engine_for(ci).stats()["decode_step_impl"] == "xla"
+        assert (
+            engine_for(ci, decode_step_impl="pallas_interpret").stats()[
+                "decode_step_impl"
+            ]
+            == "pallas_interpret"
+        )
+
+
+class TestCompositionGuards:
+    def test_bogus_impl_rejected(self, ci):
+        with pytest.raises(ValueError, match="decode_step_impl"):
+            engine_for(ci, decode_step_impl="fused")
+
+    def test_paged_kv_raises(self, ci):
+        with pytest.raises(ValueError, match="megakernel x paged"):
+            engine_for(
+                ci,
+                decode_step_impl="pallas_interpret",
+                paged_kv=True,
+                block_size=4,
+            )
+
+    def test_spec_raises(self, ci):
+        from eventstreamgpt_tpu.serving.spec import SpecConfig
+
+        config, model, params, _ = ci
+        with pytest.raises(ValueError, match="megakernel x spec"):
+            engine_for(
+                ci,
+                decode_step_impl="pallas_interpret",
+                spec=SpecConfig(model=model, params=params, config=config, k=2),
+            )
+
+    def test_mesh_raises(self, ci):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+        with pytest.raises(ValueError, match="megakernel x mesh"):
+            engine_for(ci, decode_step_impl="pallas_interpret", mesh=mesh)
+
+    def test_xla_impl_composes_everywhere(self, ci):
+        """decode_step_impl='xla' is the stock path — no guard fires."""
+        eng = engine_for(
+            ci, decode_step_impl="xla", paged_kv=True, block_size=4
+        )
+        assert eng.stats()["decode_step_impl"] == "xla"

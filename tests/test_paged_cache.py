@@ -538,3 +538,31 @@ class TestSanitizerWiring:
         eng = engine_for(ci)
         with pytest.raises(BlockLedgerError, match="zero block"):
             eng._block_alloc.decref([0])
+
+
+class TestPagedPoolBudget:
+    def test_pool_budget_doubles_params_exactly_once_under_hot_swap(self, ci):
+        """r20 regression (ISSUE 20 satellite): the paged pool budget in
+        ``slots_report`` is net of weights with hot-swap's shadow buffer
+        charged EXACTLY once — ``params_bytes`` arrives already doubled
+        from `slots_report`, and `_paged_report` must never re-double it."""
+        plain = engine_for(ci)
+        swap = engine_for(ci, hot_swap=True)
+        hbm = 16.0
+        r_plain = plain.slots_report(hbm_gb=hbm)
+        r_swap = swap.slots_report(hbm_gb=hbm)
+        p_plain, p_swap = r_plain["paged"], r_swap["paged"]
+        assert r_swap["params_bytes"] == 2 * r_plain["params_bytes"]
+        # Exact arithmetic: budget = hbm - params, params doubled once.
+        assert p_plain["pool_budget_bytes"] == int(hbm * 1e9) - r_plain["params_bytes"]
+        assert p_swap["pool_budget_bytes"] == int(hbm * 1e9) - r_swap["params_bytes"]
+        assert (
+            p_plain["pool_budget_bytes"] - p_swap["pool_budget_bytes"]
+            == r_plain["params_bytes"]
+        )
+        assert p_swap["max_pool_blocks_in_budget"] == (
+            p_swap["pool_budget_bytes"] // p_swap["bytes_per_block"]
+        )
+        # The ALLOCATED pool is invariant to hot_swap — only the budget
+        # headroom shrinks.
+        assert p_swap["pool_bytes"] == p_plain["pool_bytes"]

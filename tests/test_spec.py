@@ -520,16 +520,51 @@ class TestSpecAccounting:
         assert r_swap["draft_params_bytes"] == 2 * r_spec["draft_params_bytes"]
         assert r_swap["params_bytes"] == 2 * r_spec["params_bytes"]
 
+    def test_slots_report_charges_draft_kv_at_cache_dtype(self):
+        """r20 regression (ISSUE 20 satellite): under spec + kv_cache_dtype
+        the draft KV row is charged at the DRAFT CACHE's dtype — the draft
+        rows quantize on write exactly like the target's — not at the
+        draft's float compute dtype. The old estimate overcharged every
+        slot under spec x int8 and understated max_slots."""
+        from eventstreamgpt_tpu.ops.kv_quant import kv_cache_bytes_per_slot
+
+        config, model, params, prompt, cls = build("ci")
+        dcfg, dparams = truncated_draft(config, params, 1)
+        sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
+        spec_f = engine_for(model, params, config, prompt, spec=sc)
+        spec_q = engine_for(
+            model, params, config, prompt, spec=sc, kv_cache_dtype="int8"
+        )
+        r_f, r_q = spec_f.slots_report(), spec_q.slots_report()
+        # The quantized draft row is strictly cheaper than the float one...
+        assert 0 < r_q["draft_kv_bytes_per_slot"] < r_f["draft_kv_bytes_per_slot"]
+        # ...and matches the analytic int8 estimate exactly (int8 payload +
+        # fp32 scales, NOT the draft compute dtype).
+        expect = kv_cache_bytes_per_slot(
+            dcfg.num_hidden_layers,
+            dcfg.num_attention_heads,
+            spec_q.max_len,
+            dcfg.head_dim,
+            "int8",
+            dcfg.compute_dtype,
+        )
+        assert r_q["draft_kv_bytes_per_slot"] == expect
+
 
 @pytest.mark.slow
 @pytest.mark.spec
 class TestSpecValidation:
     def test_incompatible_knobs_raise(self):
+        """r20 composition closure: the PR 13 scope-cut errors for
+        spec × top_k/top_p and spec × quantized cache are LIFTED (those
+        cells now construct and serve); device_criteria stays a loud typed
+        error (stopping criteria fold into the decode chunk the draft
+        never runs)."""
         config, model, params, prompt, cls = build("ci")
         dcfg, dparams = truncated_draft(config, params, 1)
         sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
-        with pytest.raises(ValueError, match="top_k/top_p"):
-            engine_for(model, params, config, prompt, spec=sc, top_k=2)
+        filt = engine_for(model, params, config, prompt, spec=sc, top_k=2)
+        assert filt.spec is not None and filt.top_k == 2
         from eventstreamgpt_tpu.generation.stopping_criteria import MaxLengthCriteria
 
         with pytest.raises(ValueError, match="device_criteria"):
@@ -537,8 +572,8 @@ class TestSpecValidation:
                 model, params, config, prompt, spec=sc,
                 device_criteria=(MaxLengthCriteria(6),),
             )
-        with pytest.raises(ValueError, match="quantized"):
-            engine_for(model, params, config, prompt, spec=sc, kv_cache_dtype="int8")
+        kvq = engine_for(model, params, config, prompt, spec=sc, kv_cache_dtype="int8")
+        assert kvq.spec is not None and kvq._kv_quantized
 
     def test_service_rejects_mixed_spec_replicas(self):
         config, model, params, prompt, cls = build("ci")
@@ -549,7 +584,11 @@ class TestSpecValidation:
         with pytest.raises(ValueError, match="speculative-decoding configuration"):
             ServingService([plain, spec])
 
-    def test_prefill_stream_rejects_spec(self):
+    def test_prefill_stream_rejects_mixed_spec_tiers(self):
+        """r20: spec engines DO serve behind a prefill stream now — but
+        only when both tiers run the same speculative configuration. A
+        mixed pair (spec decode behind a plain prefill replica, or the
+        reverse) stays a loud typed error; a matched spec pair attaches."""
         from eventstreamgpt_tpu.serving import PrefillStream
 
         config, model, params, prompt, cls = build("ci")
@@ -557,8 +596,14 @@ class TestSpecValidation:
         sc = SpecConfig(model=cls(dcfg), params=dparams, config=dcfg, k=2)
         spec = engine_for(model, params, config, prompt, spec=sc)
         pf = engine_for(model, params, config, prompt)
-        with pytest.raises(NotImplementedError, match="prefill stream"):
+        with pytest.raises(ValueError, match="spec"):
             PrefillStream(pf).attach([spec])
+        spec_pf = engine_for(model, params, config, prompt, spec=sc)
+        with pytest.raises(ValueError, match="spec"):
+            PrefillStream(spec_pf).attach([pf])
+        stream = PrefillStream(spec_pf)
+        stream.attach([spec])
+        assert stream._targets == [spec]
 
 
 @pytest.mark.slow
